@@ -1,0 +1,1 @@
+from dtf_tpu.cli.runner import run  # noqa: F401

@@ -1,0 +1,44 @@
+"""CIFAR-10 ResNet-56 entry point.
+
+TPU-native successor of reference resnet_cifar_main.py (and its
+_dist/_dist_1/_ps_*/_horovod variants — the per-rank file copies
+collapse into flags/env because per-process identity is config, not
+code; SURVEY §7.9).
+
+Examples:
+  python -m dtf_tpu.cli.cifar_main --use_synthetic_data --train_steps 1 \
+      --batch_size 4 --distribution_strategy off
+  python -m dtf_tpu.cli.cifar_main --data_dir /data/cifar-10-batches-bin \
+      --distribution_strategy mirrored
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from dtf_tpu.config import parse_flags
+from dtf_tpu.cli.runner import run
+
+# per-dataset defaults — parity with define_cifar_flags + set_defaults
+# (resnet_cifar_main.py:223-230: epochs 182, batch 128)
+CIFAR_DEFAULTS = dict(
+    model="resnet56",
+    dataset="cifar10",
+    train_epochs=182,
+    batch_size=128,
+    epochs_between_evals=10,
+)
+
+
+def main(argv=None) -> dict:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    cfg = parse_flags(argv if argv is not None else sys.argv[1:],
+                      defaults=CIFAR_DEFAULTS)
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""ImageNet ResNet-50 entry point.
+
+TPU-native successor of reference resnet_imagenet_main.py (and the
+_dist/_horovod variants plus all 16 ps_server/ per-rank copies —
+SURVEY §2.1 rows 11-14, §7.9).  The flagship benchmark workload
+(BASELINE.md): ResNet-50, 1 epoch, global batch = per-worker 192 × N.
+
+Examples:
+  python -m dtf_tpu.cli.imagenet_main --use_synthetic_data --train_steps 1 \
+      --batch_size 4 --distribution_strategy off
+  python -m dtf_tpu.cli.imagenet_main --data_dir /data/imagenet \
+      --distribution_strategy tpu --dtype bf16 --batch_size 1024
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from dtf_tpu.config import parse_flags
+from dtf_tpu.cli.runner import run
+
+# parity with define_imagenet_keras_flags (resnet_imagenet_main.py:268-271:
+# train_epochs=90) + the dtype/use_tensor_lr extras of that main
+IMAGENET_DEFAULTS = dict(
+    model="resnet50",
+    dataset="imagenet",
+    train_epochs=90,
+    batch_size=256,
+    epochs_between_evals=1,
+)
+
+
+def main(argv=None) -> dict:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    cfg = parse_flags(argv if argv is not None else sys.argv[1:],
+                      defaults=IMAGENET_DEFAULTS)
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""The `run(flags_obj) -> stats` equivalent — shared body of every main.
+
+Mirrors the canonical reference call stack (SURVEY §3.1):
+session config → perf knobs → strategy → datasets → model →
+compile → callbacks → fit/evaluate → build_stats.  Returns the stats
+dict (logged as "Run stats:" like resnet_imagenet_main.py:278).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+
+import jax
+
+from dtf_tpu.config import Config
+from dtf_tpu.data import DatasetSpec, get_dataset_spec, synthetic_input_fn
+from dtf_tpu.data.pipeline import DevicePrefetcher
+from dtf_tpu.models import build_model
+from dtf_tpu.runtime import initialize, is_coordinator
+from dtf_tpu.runtime.mesh import DATA_AXIS
+from dtf_tpu.train import Trainer
+
+log = logging.getLogger("dtf_tpu")
+
+
+def effective_global_batch(cfg: Config) -> int:
+    """Batch-size semantics across strategies (SURVEY §3.3/§3.4):
+    mirrored/MWM treat --batch_size as global (Keras-fit semantics);
+    horovod/parameter_server treat it as per-process (each reference
+    rank ran its own fit with steps//size), so the global batch scales
+    with process count — which also reproduces the hvd.size() LR
+    scaling, since LR scales linearly with the global batch."""
+    if cfg.distribution_strategy in ("horovod", "parameter_server"):
+        return cfg.batch_size * jax.process_count()
+    return cfg.batch_size
+
+
+def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
+    """Returns (train_iter_factory, eval_iter_factory)."""
+    if cfg.use_synthetic_data or not cfg.data_dir:
+        if cfg.data_dir and not cfg.use_synthetic_data:
+            pass  # fall through to real readers below
+        else:
+            return (
+                lambda: synthetic_input_fn(spec, True, global_batch, cfg.seed),
+                lambda: synthetic_input_fn(spec, False, global_batch, cfg.seed + 1),
+            )
+    if spec.name == "cifar10":
+        from dtf_tpu.data.cifar import cifar_input_fn
+        return (
+            lambda: cifar_input_fn(cfg.data_dir, True, global_batch, seed=cfg.seed),
+            lambda: cifar_input_fn(cfg.data_dir, False, global_batch),
+        )
+    if spec.name == "imagenet":
+        from dtf_tpu.data.imagenet import imagenet_input_fn
+        return (
+            lambda: imagenet_input_fn(cfg.data_dir, True, global_batch,
+                                      seed=cfg.seed,
+                                      num_threads=cfg.datasets_num_private_threads),
+            lambda: imagenet_input_fn(cfg.data_dir, False, global_batch),
+        )
+    raise ValueError(f"no input pipeline for dataset {spec.name!r}")
+
+
+def run(cfg: Config) -> dict:
+    if cfg.clean and cfg.model_dir and os.path.isdir(cfg.model_dir):
+        # model_helpers.apply_clean parity (resnet_imagenet_main.py:275)
+        shutil.rmtree(cfg.model_dir, ignore_errors=True)
+    if cfg.model_dir:
+        os.makedirs(cfg.model_dir, exist_ok=True)
+
+    rt = initialize(cfg)
+    spec = get_dataset_spec(cfg.dataset)
+    if cfg.num_classes:
+        import dataclasses
+        spec = dataclasses.replace(spec, num_classes=cfg.num_classes)
+
+    global_batch = effective_global_batch(cfg)
+    cfg = cfg.replace(batch_size=global_batch)
+
+    model_name = "trivial" if cfg.use_trivial_model else cfg.model
+    model, l2 = build_model(
+        model_name, num_classes=spec.num_classes, dtype=cfg.compute_dtype,
+        bn_axis=DATA_AXIS if cfg.sync_bn else None)
+
+    trainer = Trainer(cfg, rt, model, l2, spec)
+    train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)
+
+    train_iter = train_fn()
+    first = next(train_iter)
+    state = trainer.init_state(jax.random.key(cfg.seed), first)
+
+    def chained():
+        yield first
+        yield from train_iter
+
+    prefetched = DevicePrefetcher(chained(), rt, buffer_size=2)
+
+    callbacks = []
+    if not cfg.skip_checkpoint and cfg.model_dir and is_coordinator():
+        try:
+            from dtf_tpu.train.checkpoint import CheckpointCallback
+            callbacks.append(CheckpointCallback(cfg.model_dir, trainer))
+        except ImportError:
+            pass
+    if cfg.enable_tensorboard and cfg.model_dir and is_coordinator():
+        try:
+            from dtf_tpu.utils.tensorboard import TensorBoardCallback
+            callbacks.append(TensorBoardCallback(cfg.model_dir))
+        except ImportError:
+            pass
+
+    state, stats = trainer.fit(
+        state, prefetched,
+        eval_iter_fn=None if cfg.skip_eval else eval_fn,
+        callbacks=callbacks)
+    log.info("Run stats: %s",
+             {k: v for k, v in stats.items() if k != "step_timestamp_log"})
+    return stats

@@ -1,0 +1,6 @@
+from dtf_tpu.config.flags import (  # noqa: F401
+    Config,
+    define_flags,
+    parse_flags,
+    topology_from_env,
+)

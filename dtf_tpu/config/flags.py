@@ -1,0 +1,256 @@
+"""Typed configuration + CLI flag system.
+
+One config system covering both hyperparameters and cluster topology —
+the unification SURVEY.md §5.6 calls for.  The reference splits this
+between absl flags (`official.utils.flags.core` groups composed by
+`common.define_keras_flags`, reference common.py:248-309) and the
+`TF_CONFIG` env JSON / `--worker_hosts --task_index` pair
+(reference resnet_imagenet_main.py:108-110, ps_server/*_ps_0.py:40-50).
+
+Here everything is a single dataclass, every field is a CLI flag
+(``--name value`` or ``-name value``, absl style), per-process identity
+may come from env vars, and a ``TF_CONFIG``-format JSON is still
+understood for drop-in parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Optional
+
+# Strategy names accepted by --distribution_strategy.  Mirrors the
+# reference's set (SURVEY.md §2.2) plus the TPU-native mode that
+# BASELINE.json's north star names.
+STRATEGIES = (
+    "off",
+    "one_device",
+    "mirrored",
+    "multi_worker_mirrored",
+    "horovod",
+    "parameter_server",
+    "tpu",
+)
+
+DTYPES = ("fp32", "float32", "bf16", "bfloat16", "fp16", "float16")
+
+
+@dataclasses.dataclass
+class Config:
+    """Every knob of a run.  Field comments cite the reference flag they
+    provide parity for."""
+
+    # --- base (official.utils.flags.core define_base) ---
+    data_dir: str = ""                  # --data_dir
+    model_dir: str = "/tmp/dtf_tpu"     # --model_dir
+    clean: bool = False                 # model_helpers.apply_clean (imagenet_main.py:275)
+    batch_size: int = 128               # global batch size, --batch_size
+    train_epochs: int = 182             # --train_epochs (cifar default, cifar_main.py:226-230)
+    epochs_between_evals: int = 1       # --epochs_between_evals
+    stop_threshold: Optional[float] = None  # --stop_threshold
+    export_dir: str = ""                # --export_dir (SavedModel equiv: orbax export)
+
+    # --- performance (define_performance) ---
+    dtype: str = "fp32"                 # --dtype; bf16 is the TPU-native mixed mode
+    loss_scale: Optional[float] = None  # --loss_scale; only meaningful for fp16 parity
+    enable_xla: bool = True             # --enable_xla: always-on under JAX; kept as no-op shim
+    all_reduce_alg: Optional[str] = None  # --all_reduce_alg (cifar_main.py:104) — advisory on TPU
+    num_packs: int = 1                  # --num_packs gradient packing — XLA fuses; advisory
+    datasets_num_private_threads: Optional[int] = None  # input pipeline threads
+    per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)
+    tf_gpu_thread_mode: Optional[str] = None  # no-op compat
+    batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)
+
+    # --- image / data ---
+    data_format: str = "channels_last"  # TPU/XLA prefers NHWC; channels_first accepted+transposed
+    use_synthetic_data: bool = False    # --use_synthetic_data (common.py:311-359)
+    drop_remainder: bool = True         # static shapes for XLA (imagenet_main.py:143-145)
+    image_bytes_as_serving_input: bool = False  # compat
+
+    # --- keras-flags extras (common.py:248-309) ---
+    enable_eager: bool = False          # no-op: JAX is eager outside jit by construction
+    skip_eval: bool = False             # --skip_eval
+    use_trivial_model: bool = False     # --use_trivial_model (imagenet_main.py:189-191)
+    report_accuracy_metrics: bool = True  # --report_accuracy_metrics (common.py:277-278)
+    use_tensor_lr: bool = False         # --use_tensor_lr → PiecewiseConstantDecayWithWarmup
+    enable_tensorboard: bool = False    # --enable_tensorboard (common.py:187-190)
+    train_steps: Optional[int] = None   # --train_steps cap (common.py)
+    profile_steps: Optional[str] = None  # --profile_steps "start,stop" (common.py:289-296)
+    enable_get_next_as_optional: bool = False  # partial-batch handling compat
+    log_steps: int = 100                # --log_steps for BenchmarkMetric cadence
+    skip_checkpoint: bool = False       # rank-0 checkpoints off (horovod mains default on)
+
+    # --- benchmark (define_benchmark) ---
+    benchmark_log_dir: str = ""         # --benchmark_log_dir
+    benchmark_test_id: str = ""         # --benchmark_test_id
+
+    # --- model / dataset selection ---
+    model: str = ""                     # resnet50 | resnet56|resnet20|resnet32|resnet110 | trivial
+    dataset: str = ""                   # cifar10 | imagenet
+    num_classes: Optional[int] = None   # override (imagenet: 1001, cifar: 10)
+
+    # --- distribution / topology (TF_CONFIG successor) ---
+    distribution_strategy: str = "mirrored"  # --distribution_strategy
+    num_devices: Optional[int] = None   # ≈ --num_gpus: local chips to use; None = all
+    worker_hosts: Optional[str] = None  # --worker_hosts "h1:p,h2:p" (imagenet_main.py:108-110)
+    task_index: int = -1                # --task_index
+    coordinator_address: Optional[str] = None  # jax.distributed coordinator
+    process_id: Optional[int] = None
+    process_count: Optional[int] = None
+    # mesh axis sizes; data axis is inferred from the rest (SURVEY §5.7:
+    # keep model/seq axes open even though the reference is DP-only)
+    model_parallelism: int = 1          # size of the 'model' mesh axis
+    seq_parallelism: int = 1            # size of the 'seq' mesh axis (ring attention)
+    sync_bn: bool = False               # cross-replica BN (reference default: per-replica)
+
+    # --- misc ---
+    seed: int = 0
+    verbose: int = 2                    # keras fit verbose parity (rank-gated)
+
+    def __post_init__(self):
+        if self.distribution_strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown distribution_strategy {self.distribution_strategy!r}; "
+                f"choose from {STRATEGIES}")
+        if self.dtype not in DTYPES:
+            raise ValueError(f"unknown dtype {self.dtype!r}; choose from {DTYPES}")
+
+    # -- dtype helpers -------------------------------------------------
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        if self.dtype in ("bf16", "bfloat16"):
+            return jnp.bfloat16
+        if self.dtype in ("fp16", "float16"):
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def loss_scale_value(self) -> float:
+        """Parity with flags_core.get_loss_scale: fp16 defaults to 128."""
+        if self.loss_scale is not None:
+            return float(self.loss_scale)
+        return 128.0 if self.dtype in ("fp16", "float16") else 1.0
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _coerce(field: dataclasses.Field, raw: str) -> Any:
+    t = field.type
+    if raw.lower() in ("none", "null"):
+        return None
+    if t in ("bool", bool):
+        return raw.lower() in ("true", "1", "yes", "t")
+    if "int" in str(t):
+        return int(raw)
+    if "float" in str(t):
+        return float(raw)
+    return raw
+
+
+def define_flags() -> dict:
+    """Returns {flag_name: default} — the full registry, for docs/tests."""
+    return {f.name: f.default for f in dataclasses.fields(Config)}
+
+
+def parse_flags(argv=None, defaults: Optional[dict] = None) -> Config:
+    """absl-style parsing: accepts ``--flag value``, ``--flag=value``,
+    ``-flag value`` and bare boolean flags (``--skip_eval``).
+
+    ``defaults`` plays the role of ``flags_core.set_defaults`` — the
+    per-dataset defaults each main sets (reference cifar_main.py:226-230).
+    """
+    names = {f.name: f for f in dataclasses.fields(Config)}
+    kw = dict(defaults or {})
+    argv = list(argv or [])
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if not tok.startswith("-"):
+            raise ValueError(f"unexpected argument {tok!r}")
+        name = tok.lstrip("-")
+        val = None
+        if "=" in name:
+            name, val = name.split("=", 1)
+        if name not in names:
+            raise ValueError(f"unknown flag --{name}")
+        fld = names[name]
+        if val is None:
+            nxt = argv[i + 1] if i + 1 < len(argv) else None
+            if fld.type in ("bool", bool) and (
+                    nxt is None or nxt.startswith("-") or
+                    nxt.lower() not in ("true", "false", "1", "0", "yes", "no", "t", "f")):
+                val, step = "true", 1
+            else:
+                if nxt is None:
+                    raise ValueError(f"flag --{name} needs a value")
+                val, step = nxt, 2
+        else:
+            step = 1
+        kw[name] = _coerce(fld, val)
+        i += step
+    cfg = Config(**kw)
+    return apply_env_topology(cfg)
+
+
+def topology_from_env() -> dict:
+    """Read per-process identity from the environment.
+
+    Two sources, in priority order:
+      1. DTF_COORDINATOR / DTF_PROCESS_ID / DTF_PROCESS_COUNT — native.
+      2. TF_CONFIG JSON — drop-in parity with the reference's cluster
+         contract (ps_server/resnet_imagenet_main_dist_ps_0.py:40-50):
+         {"cluster": {"worker": [host:port, ...]}, "task": {"type","index"}}.
+         The first worker doubles as the coordination-service host.
+    """
+    out: dict = {}
+    if os.environ.get("DTF_COORDINATOR"):
+        out["coordinator_address"] = os.environ["DTF_COORDINATOR"]
+    if os.environ.get("DTF_PROCESS_ID"):
+        out["process_id"] = int(os.environ["DTF_PROCESS_ID"])
+    if os.environ.get("DTF_PROCESS_COUNT"):
+        out["process_count"] = int(os.environ["DTF_PROCESS_COUNT"])
+    if out:
+        return out
+
+    tf_config = os.environ.get("TF_CONFIG")
+    if tf_config:
+        try:
+            spec = json.loads(tf_config)
+        except json.JSONDecodeError:
+            return out
+        cluster = spec.get("cluster", {})
+        task = spec.get("task", {})
+        workers = list(cluster.get("worker", []))
+        ps = list(cluster.get("ps", []))
+        # Flatten: ps ranks first then workers, matching the reference's
+        # rank numbering where ps_0 is rank 0 (SURVEY §3.4).
+        all_procs = ps + workers
+        if all_procs:
+            out["coordinator_address"] = all_procs[0]
+            out["process_count"] = len(all_procs)
+            ttype, tidx = task.get("type"), int(task.get("index", 0))
+            out["process_id"] = tidx if ttype == "ps" else len(ps) + tidx
+    return out
+
+
+def apply_env_topology(cfg: Config) -> Config:
+    """Fill unset topology fields from the environment; explicit flags win."""
+    env = topology_from_env()
+    kw = {}
+    for k, v in env.items():
+        if getattr(cfg, k) is None:
+            kw[k] = v
+    # --worker_hosts/--task_index parity (imagenet_main.py:108-110)
+    if cfg.worker_hosts and cfg.coordinator_address is None and "coordinator_address" not in kw:
+        hosts = [h.strip() for h in cfg.worker_hosts.split(",") if h.strip()]
+        kw["coordinator_address"] = hosts[0]
+        kw["process_count"] = len(hosts)
+        if cfg.task_index >= 0:
+            kw["process_id"] = cfg.task_index
+    return cfg.replace(**kw) if kw else cfg

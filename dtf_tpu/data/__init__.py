@@ -1,0 +1,3 @@
+from dtf_tpu.data.base import DatasetSpec, get_dataset_spec  # noqa: F401
+from dtf_tpu.data.synthetic import synthetic_input_fn  # noqa: F401
+from dtf_tpu.data.pipeline import DevicePrefetcher, shard_for_process  # noqa: F401

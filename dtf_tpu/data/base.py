@@ -1,0 +1,52 @@
+"""Dataset contract.
+
+The reference's contract is `input_fn(is_training, data_dir, batch_size,
+…, input_context) -> tf.data.Dataset` (SURVEY §1 L3).  Ours is the same
+shape minus tf.data: an ``input_fn`` returns a Python iterator of
+host-side numpy ``(images, labels)`` batches — infinite (repeating) for
+training, one-pass for eval — plus a :class:`DatasetSpec` describing
+cardinalities so the loop can do the reference's epoch math
+(steps_per_epoch, eval steps, `steps // num_replicas` splits).
+
+Per-process sharding follows the reference's shard-by-file rule
+(cifar_preprocessing.py:147-152): each process reads a disjoint 1/N.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetSpec:
+    name: str
+    image_size: int
+    num_channels: int
+    num_classes: int
+    num_train: int
+    num_eval: int
+    one_hot: bool          # cifar uses one-hot + categorical CE; imagenet sparse
+    mean_subtract: bool = False
+
+    @property
+    def image_shape(self):
+        return (self.image_size, self.image_size, self.num_channels)
+
+
+# Cardinalities from the reference:
+#   cifar: cifar_preprocessing.py NUM_IMAGES train 50_000 / validation 10_000
+#   imagenet: imagenet_preprocessing.py:46-49 train 1_281_167 / validation 50_000,
+#   1001 classes (label 0 = background, resnet_model num_classes=1001; sparse
+#   labels shifted to [0,1000) in parse_record :254-255 — we keep 1001-way
+#   logits with labels in [0,1001) after shift, matching the main's usage)
+CIFAR10 = DatasetSpec("cifar10", 32, 3, 10, 50_000, 10_000, one_hot=True)
+IMAGENET = DatasetSpec("imagenet", 224, 3, 1001, 1_281_167, 50_000,
+                       one_hot=False, mean_subtract=True)
+
+_SPECS = {"cifar10": CIFAR10, "cifar": CIFAR10, "imagenet": IMAGENET}
+
+
+def get_dataset_spec(name: str) -> DatasetSpec:
+    if name not in _SPECS:
+        raise ValueError(f"unknown dataset {name!r}; have {sorted(_SPECS)}")
+    return _SPECS[name]

@@ -1,0 +1,54 @@
+"""Host→device pipeline: sharding + double-buffered prefetch.
+
+TPU-native equivalent of the reference's final `dataset.prefetch` +
+MultiDeviceIterator host→device overlap (SURVEY §2.4 last row; the
+reference even monkey-patched sleep-slack into prefetch,
+common.py:380-403).  A background thread keeps `buffer_size` batches
+already transferred and laid out on the mesh while the device computes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+from dtf_tpu.runtime.mesh import MeshRuntime
+
+
+def shard_for_process(items, process_id: int, process_count: int):
+    """Disjoint 1/N split by position — the reference's shard-by-file
+    rule (cifar_preprocessing.py:147-152)."""
+    return items[process_id::process_count]
+
+
+class DevicePrefetcher:
+    """Wraps a host batch iterator; yields mesh-sharded device arrays."""
+
+    def __init__(self, it: Iterator, runtime: MeshRuntime, buffer_size: int = 2):
+        self._it = it
+        self._rt = runtime
+        self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
+        self._err = None
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch in self._it:
+                self._q.put(self._rt.shard_batch(batch))
+        except Exception as e:  # surfaced on next()
+            self._err = e
+        finally:
+            self._q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -1,0 +1,48 @@
+"""Synthetic data backend.
+
+Parity with reference common.get_synth_input_fn (common.py:311-359):
+one random batch — truncated normal images (mean 127, std 60, i.e. raw
+pixel range) and uniform integer labels — repeated forever, bypassing
+all preprocessing.  Used to find the input-pipeline-free throughput
+upper bound and by the whole smoke-test matrix
+(resnet_cifar_test.py:36-40).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dtf_tpu.data.base import DatasetSpec
+
+
+def _truncated_normal(rng, shape, mean, std):
+    """Resample outside ±2σ, like tf.random.truncated_normal."""
+    x = rng.standard_normal(shape)
+    bad = np.abs(x) > 2.0
+    while bad.any():
+        x[bad] = rng.standard_normal(int(bad.sum()))
+        bad = np.abs(x) > 2.0
+    return (x * std + mean).astype(np.float32)
+
+
+def synthetic_input_fn(spec: DatasetSpec, is_training: bool, batch_size: int,
+                       seed: int = 0, dtype=np.float32):
+    """Yields the same (images, labels) batch forever (train) or for one
+    eval pass.  labels are int32 class ids; one-hot is applied by the
+    loss layer when spec.one_hot."""
+    rng = np.random.default_rng(seed)
+    images = _truncated_normal(
+        rng, (batch_size,) + spec.image_shape, 127.0, 60.0).astype(dtype)
+    labels = rng.integers(0, spec.num_classes - 1, size=(batch_size,),
+                          dtype=np.int32)
+
+    def gen():
+        if is_training:
+            while True:
+                yield images, labels
+        else:
+            n = max(1, spec.num_eval // batch_size)
+            for _ in range(n):
+                yield images, labels
+
+    return gen()

@@ -1,0 +1,10 @@
+from dtf_tpu.models.registry import build_model, l2_weight_penalty  # noqa: F401
+from dtf_tpu.models.resnet import ResNet50  # noqa: F401
+from dtf_tpu.models.resnet_cifar import (  # noqa: F401
+    CifarResNet,
+    resnet20,
+    resnet32,
+    resnet56,
+    resnet110,
+)
+from dtf_tpu.models.trivial import TrivialModel  # noqa: F401

@@ -1,0 +1,64 @@
+"""Model registry + the L2-as-loss-term rule.
+
+The reference applies L2 through Keras kernel_regularizers, which fold
+into the loss (SURVEY §7 hard-part 5; resnet_model.py:37-43).  Here the
+same behavior is a pure function over the param pytree: every 'kernel'
+leaf is penalized, plus the final classifier's bias (the reference sets
+bias_regularizer only on fc1000/fc10 — resnet_model.py:378-380,
+resnet_cifar_model.py:250-251).  BatchNorm scale/bias are never
+penalized, matching Keras.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.models import resnet, resnet_cifar, trivial
+
+# reference weight-decay constants
+L2_IMAGENET = 1e-4  # resnet_model.py:37
+L2_CIFAR = 2e-4     # resnet_cifar_model.py:36
+
+_REGISTRY = {
+    "resnet50": (resnet.ResNet50, 1001, L2_IMAGENET),
+    "resnet20": (resnet_cifar.resnet20, 10, L2_CIFAR),
+    "resnet32": (resnet_cifar.resnet32, 10, L2_CIFAR),
+    "resnet56": (resnet_cifar.resnet56, 10, L2_CIFAR),
+    "resnet110": (resnet_cifar.resnet110, 10, L2_CIFAR),
+    "resnet662": (resnet_cifar.resnet662, 10, L2_CIFAR),
+    "trivial": (trivial.TrivialModel, 1001, 0.0),
+}
+
+
+def build_model(name: str, num_classes: int | None = None,
+                dtype: Any = jnp.float32, bn_axis: str | None = None):
+    """Returns (module, l2_weight).  `bn_axis` names the mesh axis for
+    cross-replica (sync) BatchNorm; None = per-replica statistics, the
+    reference's implicit MirroredStrategy behavior (SURVEY §7.4)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    ctor, default_classes, l2 = _REGISTRY[name]
+    kw = dict(num_classes=num_classes or default_classes, dtype=dtype)
+    if name != "trivial":
+        kw["bn_axis"] = bn_axis
+    module = ctor(**kw)
+    return module, l2
+
+
+def l2_weight_penalty(params, l2_weight: float) -> jax.Array:
+    """Keras-parity L2 term: l2 * sum(w²) over conv/dense kernels and the
+    classifier bias.  Note Keras `regularizers.l2(l)` is `l * sum(w²)`
+    (no 0.5 factor)."""
+    if not l2_weight:
+        return jnp.zeros((), jnp.float32)
+    total = jnp.zeros((), jnp.float32)
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        last = keys[-1] if keys else ""
+        penalized = last == "kernel" or (last == "bias" and "fc" in keys)
+        if penalized:
+            total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return l2_weight * total

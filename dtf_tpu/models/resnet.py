@@ -1,0 +1,108 @@
+"""ResNet-50 v1.5 for 224×224 ImageNet, as a flax module.
+
+Capability parity with reference resnet_model.py (resnet50, :224-389):
+  - bottleneck blocks [1×1, 3×3(stride), 1×1]; the stride sits on the
+    3×3 ("v1.5", reference conv_block:124-221)
+  - stage layout 3/4/6/3, filters (64,64,256)→(512,512,2048)
+  - conv1: 7×7 stride 2, explicit (3,3) zero-pad, no bias
+  - BatchNorm momentum 0.9, eps 1e-5 (resnet_model.py:38-39)
+  - he_normal conv init; final Dense init N(0, 0.01) (:377)
+  - L2 weight decay 1e-4 applied as a loss term over conv/dense kernels
+    AND the final dense bias (:37-43, :378-380) — see registry.l2_weight_penalty
+  - logits cast to float32 before softmax under mixed precision (:383-385)
+
+TPU-first choices: NHWC layout (MXU/XLA native), bf16 compute with fp32
+params and fp32 BatchNorm, padding='SAME' where it is numerically
+identical, logits returned (loss applies log-softmax — cheaper and
+fused by XLA; the reference bakes softmax into the model).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BATCH_NORM_DECAY = 0.9
+BATCH_NORM_EPSILON = 1e-5
+
+conv_init = nn.initializers.he_normal()
+dense_init = nn.initializers.normal(stddev=0.01)
+
+
+class BottleneckBlock(nn.Module):
+    """conv_block / identity_block of reference resnet_model.py:46-221."""
+    filters: Sequence[int]
+    strides: int = 1
+    projection: bool = False
+    dtype: Any = jnp.float32
+    bn_axis: Any = None  # axis_name for cross-replica (sync) BN
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        f1, f2, f3 = self.filters
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     axis_name=self.bn_axis,
+                     momentum=BATCH_NORM_DECAY, epsilon=BATCH_NORM_EPSILON,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+        shortcut = x
+        y = conv(f1, (1, 1), name="conv_a")(x)
+        y = bn(name="bn_a")(y)
+        y = nn.relu(y)
+        y = conv(f2, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME", name="conv_b")(y)
+        y = bn(name="bn_b")(y)
+        y = nn.relu(y)
+        y = conv(f3, (1, 1), name="conv_c")(y)
+        y = bn(name="bn_c")(y)
+        if self.projection:
+            shortcut = conv(f3, (1, 1), strides=(self.strides, self.strides),
+                            name="conv_proj")(x)
+            shortcut = bn(name="bn_proj")(shortcut)
+        return nn.relu(y + shortcut.astype(y.dtype))
+
+
+class ResNet50(nn.Module):
+    """Returns float32 logits of shape [batch, num_classes]."""
+    num_classes: int = 1001
+    dtype: Any = jnp.float32
+    bn_axis: Any = None  # axis_name for cross-replica (sync) BN
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        # conv1: explicit (3,3) pad + VALID 7×7/2 ≡ reference conv1_pad+conv1
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, kernel_init=conv_init, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="conv1")(x)
+        x = nn.BatchNorm(use_running_average=not train,
+                         axis_name=self.bn_axis,
+                         momentum=BATCH_NORM_DECAY, epsilon=BATCH_NORM_EPSILON,
+                         dtype=jnp.float32, param_dtype=jnp.float32,
+                         name="bn_conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        stages = (
+            ((64, 64, 256), 3, 1),
+            ((128, 128, 512), 4, 2),
+            ((256, 256, 1024), 6, 2),
+            ((512, 512, 2048), 3, 2),
+        )
+        for s, (filters, blocks, stride) in enumerate(stages, start=2):
+            x = BottleneckBlock(filters, strides=stride, projection=True,
+                                dtype=self.dtype, bn_axis=self.bn_axis, name=f"stage{s}_block0")(
+                                    x, train=train)
+            for b in range(1, blocks):
+                x = BottleneckBlock(filters, dtype=self.dtype, bn_axis=self.bn_axis,
+                                    name=f"stage{s}_block{b}")(x, train=train)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, kernel_init=dense_init,
+                     dtype=self.dtype, param_dtype=jnp.float32, name="fc")(x)
+        # mixed-precision parity: logits in float32 (resnet_model.py:383-385)
+        return x.astype(jnp.float32)

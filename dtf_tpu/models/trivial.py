@@ -1,0 +1,28 @@
+"""Trivial throughput-probe model.
+
+Parity with reference trivial_model.py:26-41: flatten → Dense(1) →
+Dense(num_classes).  Exists to benchmark the input pipeline with
+near-zero device compute (used via --use_trivial_model,
+reference resnet_imagenet_main.py:189-191).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class TrivialModel(nn.Module):
+    num_classes: int = 1001
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32, name="fc1")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="fc")(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,8 @@
+from dtf_tpu.runtime.mesh import (  # noqa: F401
+    MeshRuntime,
+    initialize,
+    is_coordinator,
+    local_device_count,
+    process_count,
+    process_index,
+)

@@ -1,0 +1,2 @@
+from dtf_tpu.train.loop import Trainer, TrainState  # noqa: F401
+from dtf_tpu.train import schedules  # noqa: F401

@@ -1,0 +1,298 @@
+"""The SPMD training/eval loop — the `model.fit` equivalent.
+
+Re-expresses the reference's Keras-fit semantics (SURVEY §7.3) in a
+custom jitted loop:
+  - per-step LR schedule inside the compiled step (replacing
+    LearningRateBatchScheduler, common.py:36-73)
+  - TimeHistory BenchmarkMetric cadence (utils.logs)
+  - `epochs_between_evals`, `train_steps` cap, `skip_eval`
+    (reference resnet_cifar_main.py:176-214)
+  - build_stats-compatible result dict (common.py:202-245)
+  - fp16 static loss scaling parity (resnet_imagenet_main.py:182-187);
+    bf16 (the TPU-native mixed mode) needs none
+
+Parallelism: one SPMD core for every strategy (SURVEY §2.2).  The step
+is `jit(shard_map(...))` over the runtime mesh: each data-shard computes
+a local forward/backward (per-replica BatchNorm statistics — the
+reference's implicit MirroredStrategy choice), gradients and metrics are
+`lax.pmean`-ed over the 'data' axis (XLA emits the ICI/DCN all-reduce —
+the NCCL-ring / collective-allreduce / grpc-push-pull equivalent), and
+every replica applies an identical update.  Params live replicated;
+state buffers are donated so updates are in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import PartitionSpec as P
+
+from dtf_tpu.config import Config
+from dtf_tpu.data.base import DatasetSpec
+from dtf_tpu.models.registry import l2_weight_penalty
+from dtf_tpu.runtime.mesh import DATA_AXIS, MeshRuntime
+from dtf_tpu.train import schedules as sched_lib
+from dtf_tpu.train.optimizer import keras_sgd
+from dtf_tpu.utils.logs import TimeHistory, build_stats
+
+log = logging.getLogger("dtf_tpu")
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def cross_entropy(logits, labels):
+    """Mean CE with integer labels; numerically identical to the
+    reference's categorical CE over one-hot labels."""
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+
+class Trainer:
+    """Builds jitted SPMD train/eval steps and runs the fit loop."""
+
+    def __init__(self, cfg: Config, runtime: MeshRuntime, model,
+                 l2_weight: float, spec: DatasetSpec,
+                 schedule: Optional[Callable] = None):
+        self.cfg = cfg
+        self.rt = runtime
+        self.model = model
+        self.l2_weight = l2_weight
+        self.spec = spec
+
+        # ---- epoch math (SURVEY §3.3/3.4 steps//size semantics) ----
+        # cfg.batch_size is the GLOBAL batch. In horovod/parameter_server
+        # parity modes the reference flag was per-worker; the CLI layer
+        # multiplies by process count before we get here.
+        self.global_batch = cfg.batch_size
+        if self.global_batch % runtime.num_replicas:
+            raise ValueError(
+                f"global batch_size {self.global_batch} must be divisible by "
+                f"the number of data-parallel replicas "
+                f"({runtime.num_replicas}); pick a batch size that is a "
+                f"multiple, or reduce --num_devices")
+        self.steps_per_epoch = spec.num_train // self.global_batch
+        self.train_epochs = cfg.train_epochs
+        if cfg.train_steps:
+            # reference mains: train_steps caps to 1 epoch of that length
+            self.steps_per_epoch = min(cfg.train_steps, self.steps_per_epoch)
+            self.train_epochs = 1
+        self.eval_steps = spec.num_eval // self.global_batch
+
+        self.schedule = schedule or sched_lib.for_dataset(
+            spec.name, self.global_batch, max(self.steps_per_epoch, 1),
+            spec.num_train, use_tensor_lr=cfg.use_tensor_lr)
+        self.tx = keras_sgd(self.schedule, momentum=0.9)
+        self.loss_scale = cfg.loss_scale_value
+
+        self._build_steps()
+
+    # ------------------------------------------------------------------
+    def init_state(self, rng: jax.Array, sample_batch) -> TrainState:
+        """Seed-synced replicated init — the Horovod
+        BroadcastGlobalVariablesCallback(0) equivalent (SURVEY §2.2):
+        every process initializes from the same seed, so params are
+        identical without a broadcast."""
+        images = jnp.asarray(sample_batch[0][:1])
+        variables = jax.jit(self.model.init, static_argnames=("train",))(
+            rng, images, train=False)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        opt_state = self.tx.init(params)
+        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                           batch_stats=batch_stats, opt_state=opt_state)
+        # replicate across the mesh
+        return jax.device_put(state, self.rt.replicated())
+
+    # ------------------------------------------------------------------
+    def _apply(self, params, batch_stats, images, train):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        if train:
+            out, mutated = self.model.apply(
+                variables, images, train=True,
+                mutable=["batch_stats"] if batch_stats else [])
+            new_stats = mutated.get("batch_stats", batch_stats) if batch_stats else batch_stats
+            return out, new_stats
+        return self.model.apply(variables, images, train=False), batch_stats
+
+    def _build_steps(self):
+        mesh = self.rt.mesh
+        data_spec = P(DATA_AXIS)
+        rep = P()
+        loss_scale = self.loss_scale
+        l2w = self.l2_weight
+
+        def local_train_step(state: TrainState, images, labels):
+            def loss_fn(params):
+                logits, new_stats = self._apply(params, state.batch_stats,
+                                                images, train=True)
+                ce = cross_entropy(logits, labels)
+                loss = ce + l2_weight_penalty(params, l2w)
+                return loss * loss_scale, (loss, logits, new_stats)
+
+            grads, (loss, logits, new_stats) = jax.grad(
+                loss_fn, has_aux=True)(state.params)
+            if loss_scale != 1.0:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / loss_scale, grads)
+            # DEVICE/NETWORK BOUNDARY: gradient all-reduce over 'data'
+            # (≡ NCCL ring / collective allreduce / PS push-pull, SURVEY §3)
+            grads = jax.lax.pmean(grads, DATA_AXIS)
+            # per-replica BN stats averaged on update — MirroredStrategy's
+            # variable aggregation semantics
+            new_stats = jax.lax.pmean(new_stats, DATA_AXIS)
+
+            updates, new_opt = self.tx.update(
+                grads, state.opt_state, state.params, step=state.step)
+            params = optax.apply_updates(state.params, updates)
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            metrics = {
+                "loss": jax.lax.pmean(loss, DATA_AXIS),
+                "accuracy": jax.lax.pmean(acc, DATA_AXIS),
+                "learning_rate": self.schedule(state.step),
+            }
+            return TrainState(step=state.step + 1, params=params,
+                              batch_stats=new_stats, opt_state=new_opt), metrics
+
+        def local_eval_step(state: TrainState, images, labels):
+            logits, _ = self._apply(state.params, state.batch_stats,
+                                    images, train=False)
+            loss = cross_entropy(logits, labels)
+            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            return (jax.lax.pmean(loss, DATA_AXIS),
+                    jax.lax.pmean(acc, DATA_AXIS))
+
+        state_spec = rep
+
+        train_sharded = jax.shard_map(
+            local_train_step, mesh=mesh,
+            in_specs=(state_spec, data_spec, data_spec),
+            out_specs=(state_spec, state_spec),
+            check_vma=False)
+        eval_sharded = jax.shard_map(
+            local_eval_step, mesh=mesh,
+            in_specs=(state_spec, data_spec, data_spec),
+            out_specs=(state_spec, state_spec),
+            check_vma=False)
+
+        self.train_step = jax.jit(train_sharded, donate_argnums=(0,))
+        self.eval_step = jax.jit(eval_sharded)
+
+    # ------------------------------------------------------------------
+    def evaluate(self, state: TrainState, eval_iter: Iterator):
+        losses, accs, n = [], [], 0
+        for images, labels in eval_iter:
+            batch = self.rt.shard_batch((images, labels))
+            loss, acc = self.eval_step(state, *batch)
+            losses.append(loss)
+            accs.append(acc)
+            n += 1
+        if not n:
+            return None
+        return (float(np.mean(jax.device_get(losses))),
+                float(np.mean(jax.device_get(accs))))
+
+    # ------------------------------------------------------------------
+    def fit(self, state: TrainState, train_iter: Iterator,
+            eval_iter_fn: Optional[Callable[[], Iterator]] = None,
+            callbacks: Optional[list] = None):
+        """Runs training; returns (state, stats-dict) where the stats dict
+        is key-compatible with common.build_stats output."""
+        cfg = self.cfg
+        time_cb = TimeHistory(self.global_batch, cfg.log_steps)
+        callbacks = [time_cb] + list(callbacks or [])
+        acc_key = ("categorical_accuracy" if self.spec.one_hot
+                   else "sparse_categorical_accuracy")
+        history: dict = {"loss": [], acc_key: []}
+        profile_range = _parse_profile_steps(cfg.profile_steps)
+        profiling = False
+
+        for cb in callbacks:
+            _call(cb, "on_train_begin", None)
+        eval_output = None
+        metrics = None
+        global_step = 0
+        t0 = time.time()
+        for epoch in range(self.train_epochs):
+            for cb in callbacks:
+                _call(cb, "on_epoch_begin", epoch, None)
+            for batch_idx in range(self.steps_per_epoch):
+                for cb in callbacks:
+                    _call(cb, "on_batch_begin", batch_idx, None)
+                if profile_range and global_step == profile_range[0]:
+                    jax.profiler.start_trace(cfg.model_dir)
+                    profiling = True
+                images, labels = next(train_iter)
+                if hasattr(images, "device"):  # already sharded by prefetcher
+                    sharded = (images, labels)
+                else:
+                    sharded = self.rt.shard_batch((images, labels))
+                state, metrics = self.train_step(state, *sharded)
+                global_step += 1
+                if global_step % cfg.log_steps == 0:
+                    metrics["loss"].block_until_ready()
+                if profiling and global_step > profile_range[1]:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                for cb in callbacks:
+                    _call(cb, "on_batch_end", batch_idx, None)
+            # epoch end: materialize the last step's metrics (keras history
+            # records per-epoch training metrics)
+            m = jax.device_get(metrics)
+            history["loss"].append(float(m["loss"]))
+            history[acc_key].append(float(m["accuracy"]))
+            for cb in callbacks:
+                _call(cb, "on_epoch_end", epoch,
+                      {"state": state, "history": history})
+            if cfg.verbose and (jax.process_index() == 0):
+                log.info("epoch %d/%d: loss=%.4f top1=%.4f lr=%.5f",
+                         epoch + 1, self.train_epochs, history["loss"][-1],
+                         history[acc_key][-1], float(m["learning_rate"]))
+            run_eval = (not cfg.skip_eval and eval_iter_fn is not None and
+                        ((epoch + 1) % cfg.epochs_between_evals == 0 or
+                         epoch + 1 == self.train_epochs))
+            if run_eval:
+                eval_output = self.evaluate(state, eval_iter_fn())
+                if eval_output and jax.process_index() == 0:
+                    log.info("eval: loss=%.4f top1=%.4f",
+                             eval_output[0], eval_output[1])
+        if profiling:
+            jax.profiler.stop_trace()
+        for cb in callbacks:
+            _call(cb, "on_train_end", {"state": state, "history": history})
+        jax.block_until_ready(state.params)
+        log.info("train wall time: %.1fs (%d steps)",
+                 time.time() - t0, global_step)
+        stats = build_stats(history, eval_output, time_cb)
+        return state, stats
+
+
+def _call(cb, name, *args):
+    fn = getattr(cb, name, None)
+    if fn is not None:
+        fn(*args)
+
+
+def _parse_profile_steps(profile_steps: Optional[str]):
+    """--profile_steps "start,stop" parity (common.py:289-296)."""
+    if not profile_steps:
+        return None
+    parts = [p.strip() for p in str(profile_steps).split(",")]
+    if len(parts) != 2:
+        raise ValueError("profile_steps must be 'start,stop'")
+    return int(parts[0]), int(parts[1])

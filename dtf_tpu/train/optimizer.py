@@ -1,0 +1,56 @@
+"""Optimizers.
+
+The reference uses exactly one: Keras SGD with momentum 0.9
+(common.get_optimizer, common.py:169-172).  Keras momentum semantics:
+
+    v_t = momentum * v_{t-1} - lr_t * g_t
+    w_t = w_{t-1} + v_t
+
+which differs from optax.sgd's trace form (`w -= lr*(g + m*trace)`)
+whenever the LR changes between steps — and the schedules here step the
+LR, so we implement the Keras form exactly as an optax
+GradientTransformation.
+
+Loss scaling (fp16 parity, resnet_imagenet_main.py:182-187): handled in
+the train step — loss is multiplied by `loss_scale` and gradients
+divided back before this transform sees them (static scale; TPU bf16
+needs none, which is the default path).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class KerasSGDState(NamedTuple):
+    velocity: optax.Updates
+
+
+def keras_sgd(learning_rate: Callable, momentum: float = 0.9
+              ) -> optax.GradientTransformation:
+    """SGD with Keras-style momentum; `learning_rate` is fn(step)->f32,
+    `step` is read from the caller-provided count in update's extra arg."""
+
+    def init(params):
+        return KerasSGDState(
+            velocity=jax.tree_util.tree_map(jnp.zeros_like, params))
+
+    def update(grads, state, params=None, *, step):
+        lr = learning_rate(step)
+        velocity = jax.tree_util.tree_map(
+            lambda v, g: momentum * v - lr * g.astype(v.dtype),
+            state.velocity, grads)
+        return velocity, KerasSGDState(velocity=velocity)
+
+    return optax.GradientTransformation(init, update)
+
+
+def build_optimizer(name: str, learning_rate: Callable,
+                    momentum: float = 0.9) -> optax.GradientTransformation:
+    if name in ("sgd", "momentum"):
+        return keras_sgd(learning_rate, momentum)
+    raise ValueError(f"unknown optimizer {name!r}")

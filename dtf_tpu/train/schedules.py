@@ -1,0 +1,129 @@
+"""Learning-rate schedules as pure functions of the global step.
+
+The reference drives LR three ways (SURVEY §2.1/§7):
+  1. CIFAR per-batch callback schedule — linear scaling ×bs/128, steps
+     at epochs 91/136/182 (resnet_cifar_main.py:34-65 +
+     common.LearningRateBatchScheduler:36-73).
+  2. ImageNet per-batch callback schedule — ×bs/256, 5-epoch linear
+     warmup, steps at 30/60/80 (resnet_imagenet_main.py:37-71).
+  3. Tensor schedule PiecewiseConstantDecayWithWarmup — same shape,
+     computed in-graph (common.py:76-140, via --use_tensor_lr).
+
+Under XLA the callback/tensor distinction disappears: every schedule is
+a jit-traceable fn(step)->f32, evaluated inside the train step — which
+is exactly what the "tensor LR" path wanted to be.  The callback-path
+semantics (epoch-granular decay, fractional-epoch warmup) are preserved
+exactly.
+
+Horovod's LearningRateWarmupCallback(warmup_epochs=3)
+(resnet_cifar_main_horovod.py, SURVEY §3.3) is the `warmup_epochs`
+argument on either schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+BASE_LEARNING_RATE = 0.1  # common.py:32
+
+# (multiplier, epoch_to_start) tables, verbatim semantics:
+CIFAR_LR_SCHEDULE = ((0.1, 91), (0.01, 136), (0.001, 182))   # cifar_main.py:34-36
+IMAGENET_LR_SCHEDULE = ((1.0, 5), (0.1, 30), (0.01, 60), (0.001, 80))  # imagenet_main.py:37-39
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def piecewise_by_epoch(batch_size: int, steps_per_epoch: int,
+                       base_batch: int, table: Sequence,
+                       warmup_epochs: float = 0.0) -> Schedule:
+    """Epoch-granular piecewise-constant decay with optional per-step
+    linear warmup; linear scaling rule `BASE_LR * batch / base_batch`."""
+    initial_lr = BASE_LEARNING_RATE * batch_size / base_batch
+
+    def fn(step):
+        step = step.astype(jnp.float32)
+        epoch = jnp.floor(step / steps_per_epoch)
+        lr = jnp.float32(initial_lr)
+        for mult, start_epoch in table:
+            lr = jnp.where(epoch >= start_epoch, initial_lr * mult, lr)
+        if warmup_epochs > 0:
+            warmup_steps = warmup_epochs * steps_per_epoch
+            frac_epoch = step / steps_per_epoch
+            warmup_lr = initial_lr * (frac_epoch / warmup_epochs)
+            lr = jnp.where(step < warmup_steps, warmup_lr, lr)
+        return lr
+
+    return fn
+
+
+def cifar_schedule(batch_size: int, steps_per_epoch: int) -> Schedule:
+    """resnet_cifar_main.learning_rate_schedule: no warmup, ÷128 scaling.
+    Note the reference's epoch counter is `on_epoch_begin`-driven, i.e.
+    floor(step/steps_per_epoch) — identical here."""
+    return piecewise_by_epoch(batch_size, steps_per_epoch, 128,
+                              CIFAR_LR_SCHEDULE)
+
+
+def imagenet_schedule(batch_size: int, steps_per_epoch: int) -> Schedule:
+    """resnet_imagenet_main.learning_rate_schedule: fractional-epoch
+    5-epoch warmup then steps at 30/60/80, ÷256 scaling.  The reference
+    computes warmup on `epoch + batch/batches_per_epoch` — i.e. pure
+    step fraction, matching here."""
+    initial_lr = BASE_LEARNING_RATE * batch_size / 256
+    warmup_mult, warmup_end = IMAGENET_LR_SCHEDULE[0]
+
+    def fn(step):
+        step = step.astype(jnp.float32)
+        frac_epoch = step / steps_per_epoch
+        epoch = jnp.floor(frac_epoch)
+        lr = jnp.float32(initial_lr)
+        for mult, start_epoch in IMAGENET_LR_SCHEDULE:
+            lr = jnp.where(epoch >= start_epoch, initial_lr * mult, lr)
+        warmup_lr = initial_lr * warmup_mult * frac_epoch / warmup_end
+        return jnp.where(frac_epoch < warmup_end, warmup_lr, lr)
+
+    return fn
+
+
+def piecewise_constant_with_warmup(batch_size: int, epoch_size: int,
+                                   warmup_epochs: int = 5,
+                                   boundaries: Sequence[int] = (30, 60, 80),
+                                   multipliers: Sequence[float] = (1.0, 0.1, 0.01, 0.001),
+                                   ) -> Schedule:
+    """Parity with common.PiecewiseConstantDecayWithWarmup (:76-140), the
+    --use_tensor_lr path: step-boundary decay (not epoch-floor) and
+    warmup to the *unmultiplied* rescaled LR."""
+    if len(boundaries) != len(multipliers) - 1:
+        raise ValueError("len(boundaries) must be len(multipliers) - 1")
+    steps_per_epoch = epoch_size // batch_size
+    rescaled_lr = BASE_LEARNING_RATE * batch_size / 256
+    step_boundaries = [float(steps_per_epoch) * b for b in boundaries]
+    lr_values = [rescaled_lr * m for m in multipliers]
+    warmup_steps = warmup_epochs * steps_per_epoch
+
+    def fn(step):
+        step = step.astype(jnp.float32)
+        lr = jnp.float32(lr_values[0])
+        for b, v in zip(step_boundaries, lr_values[1:]):
+            lr = jnp.where(step > b, v, lr)
+        warmup_lr = rescaled_lr * step / max(warmup_steps, 1)
+        return jnp.where(step < warmup_steps, warmup_lr, lr)
+
+    return fn
+
+
+def constant(lr: float) -> Schedule:
+    def fn(step):
+        return jnp.float32(lr)
+    return fn
+
+
+def for_dataset(dataset: str, batch_size: int, steps_per_epoch: int,
+                epoch_size: int, use_tensor_lr: bool = False) -> Schedule:
+    if dataset.startswith("cifar"):
+        return cifar_schedule(batch_size, steps_per_epoch)
+    if use_tensor_lr:
+        return piecewise_constant_with_warmup(batch_size, epoch_size)
+    return imagenet_schedule(batch_size, steps_per_epoch)

@@ -1,0 +1,1 @@
+from dtf_tpu.utils.logs import TimeHistory, BatchTimestamp, build_stats  # noqa: F401

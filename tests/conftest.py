@@ -1,0 +1,29 @@
+"""Test harness: 8 virtual CPU devices standing in for a TPU mesh.
+
+This closes the reference's biggest testing gap (SURVEY §4): its
+multi-worker paths had no automated tests at all — correctness was
+validated by manually-run cluster logs (ps_server/log*.log).  Here every
+distribution strategy is exercised on an
+``--xla_force_host_platform_device_count=8`` CPU mesh in CI.
+"""
+
+import os
+
+# Must be set before the JAX backend initializes.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,75 @@
+"""Data layer tests: synthetic backend parity + device prefetcher."""
+
+import numpy as np
+
+from dtf_tpu.config import Config
+from dtf_tpu.data import DevicePrefetcher, get_dataset_spec, synthetic_input_fn
+from dtf_tpu.data.base import CIFAR10
+from dtf_tpu.data.pipeline import shard_for_process
+from dtf_tpu.runtime import initialize
+
+
+def test_synthetic_shapes_and_range():
+    it = synthetic_input_fn(CIFAR10, True, 4)
+    images, labels = next(it)
+    assert images.shape == (4, 32, 32, 3)
+    assert labels.shape == (4,)
+    assert labels.dtype == np.int32
+    # truncated normal mean 127 std 60, clipped at ±2σ (common.py:337-341)
+    assert images.min() >= 127 - 2 * 60 - 1e-3
+    assert images.max() <= 127 + 2 * 60 + 1e-3
+    assert 0 <= labels.min() and labels.max() < 10
+
+
+def test_synthetic_repeats_same_batch():
+    """Parity: from_tensors(...).repeat() — identical batch each step."""
+    it = synthetic_input_fn(CIFAR10, True, 2)
+    a = next(it)
+    b = next(it)
+    np.testing.assert_array_equal(a[0], b[0])
+
+
+def test_synthetic_eval_finite():
+    it = synthetic_input_fn(CIFAR10, False, 2048)
+    batches = list(it)
+    assert len(batches) == CIFAR10.num_eval // 2048
+
+
+def test_spec_lookup():
+    assert get_dataset_spec("imagenet").num_train == 1_281_167
+    assert get_dataset_spec("cifar10").num_train == 50_000
+
+
+def test_shard_for_process():
+    files = list(range(10))
+    shards = [shard_for_process(files, i, 3) for i in range(3)]
+    assert sorted(sum(shards, [])) == files
+    assert all(len(set(s)) == len(s) for s in shards)
+
+
+def test_device_prefetcher():
+    cfg = Config(distribution_strategy="mirrored", num_devices=2)
+    rt = initialize(cfg)
+    data = [(np.ones((4, 8, 8, 3), np.float32) * i,
+             np.zeros((4,), np.int32)) for i in range(5)]
+    out = list(DevicePrefetcher(iter(data), rt))
+    assert len(out) == 5
+    np.testing.assert_allclose(np.asarray(out[3][0])[0, 0, 0, 0], 3.0)
+
+
+def test_device_prefetcher_propagates_errors():
+    cfg = Config(distribution_strategy="off")
+    rt = initialize(cfg)
+
+    def bad():
+        yield (np.ones((2, 4, 4, 3), np.float32), np.zeros((2,), np.int32))
+        raise RuntimeError("reader died")
+
+    pf = DevicePrefetcher(bad(), rt)
+    next(pf)
+    try:
+        next(pf)
+        raised = False
+    except RuntimeError:
+        raised = True
+    assert raised

@@ -1,0 +1,35 @@
+"""Keras-SGD-momentum parity: v = m*v - lr*g; w += v
+(reference common.get_optimizer, common.py:169-172; Keras semantics —
+NOT optax's trace form, which diverges when the LR steps)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dtf_tpu.train.optimizer import keras_sgd
+
+
+def test_keras_momentum_with_changing_lr():
+    lrs = [0.1, 0.1, 0.01]  # schedule steps down
+    sched = lambda step: jnp.asarray(lrs)[step]
+    tx = keras_sgd(sched, momentum=0.9)
+    w = jnp.asarray([1.0])
+    g = jnp.asarray([0.5])
+    state = tx.init(w)
+
+    v_ref, w_ref = 0.0, 1.0
+    for step in range(3):
+        updates, state = tx.update(g, state, w, step=jnp.asarray(step))
+        w = optax.apply_updates(w, updates)
+        lr = lrs[step]
+        v_ref = 0.9 * v_ref - lr * 0.5
+        w_ref = w_ref + v_ref
+        np.testing.assert_allclose(np.asarray(w), [w_ref], rtol=1e-6,
+                                   err_msg=f"step {step}")
+
+
+def test_velocity_dtype_matches_params():
+    tx = keras_sgd(lambda s: jnp.float32(0.1))
+    params = {"a": jnp.zeros((2, 2), jnp.float32)}
+    state = tx.init(params)
+    assert state.velocity["a"].dtype == jnp.float32

@@ -1,0 +1,96 @@
+"""LR schedule parity tests against the reference formulas
+(resnet_cifar_main.py:39-65, resnet_imagenet_main.py:42-71,
+common.py:76-140), re-derived independently in numpy."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.train import schedules
+
+
+def ref_cifar_lr(epoch, batch_size):
+    """Reference resnet_cifar_main.learning_rate_schedule, re-derived."""
+    initial = 0.1 * batch_size / 128
+    lr = initial
+    for mult, start in ((0.1, 91), (0.01, 136), (0.001, 182)):
+        if epoch >= start:
+            lr = initial * mult
+        else:
+            break
+    return lr
+
+
+def test_cifar_schedule_boundaries():
+    bs, spe = 128, 390
+    fn = schedules.cifar_schedule(bs, spe)
+    for epoch in (0, 1, 90, 91, 135, 136, 181, 182, 200):
+        step = jnp.asarray(epoch * spe, jnp.int32)
+        np.testing.assert_allclose(float(fn(step)), ref_cifar_lr(epoch, bs),
+                                   rtol=1e-6, err_msg=f"epoch {epoch}")
+
+
+def test_cifar_linear_scaling():
+    fn = schedules.cifar_schedule(256, 100)
+    assert float(fn(jnp.asarray(0))) == pytest.approx(0.1 * 256 / 128)
+
+
+def ref_imagenet_lr(epoch, batch, batches_per_epoch, batch_size):
+    """Reference resnet_imagenet_main.learning_rate_schedule, re-derived."""
+    table = ((1.0, 5), (0.1, 30), (0.01, 60), (0.001, 80))
+    initial = 0.1 * batch_size / 256
+    e = epoch + batch / batches_per_epoch
+    warm_mult, warm_end = table[0]
+    if e < warm_end:
+        return initial * warm_mult * e / warm_end
+    lr = initial
+    for mult, start in table:
+        if e >= start:
+            lr = initial * mult
+        else:
+            break
+    return lr
+
+
+def test_imagenet_schedule_warmup_and_decay():
+    bs, spe = 256, 500
+    fn = schedules.imagenet_schedule(bs, spe)
+    for epoch, batch in ((0, 0), (0, 250), (2, 100), (4, 499), (5, 0),
+                         (29, 0), (30, 0), (59, 499), (60, 0), (80, 0), (89, 0)):
+        step = jnp.asarray(epoch * spe + batch, jnp.int32)
+        expected = ref_imagenet_lr(epoch, batch, spe, bs)
+        np.testing.assert_allclose(float(fn(step)), expected, rtol=1e-5,
+                                   err_msg=f"epoch {epoch} batch {batch}")
+
+
+def test_tensor_lr_parity():
+    """PiecewiseConstantDecayWithWarmup (common.py:76-140): warmup to the
+    rescaled LR over 5 epochs, then step boundaries (step > boundary)."""
+    bs, epoch_size = 256, 1_281_167
+    spe = epoch_size // bs
+    fn = schedules.piecewise_constant_with_warmup(bs, epoch_size)
+    rescaled = 0.1 * bs / 256
+    warmup_steps = 5 * spe
+    # mid-warmup: linear in step
+    step = warmup_steps // 2
+    np.testing.assert_allclose(float(fn(jnp.asarray(step))),
+                               rescaled * step / warmup_steps, rtol=1e-5)
+    # after warmup, before first boundary
+    np.testing.assert_allclose(float(fn(jnp.asarray(10 * spe))), rescaled,
+                               rtol=1e-6)
+    # after the 30-epoch boundary
+    np.testing.assert_allclose(float(fn(jnp.asarray(31 * spe))),
+                               rescaled * 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(fn(jnp.asarray(81 * spe))),
+                               rescaled * 0.001, rtol=1e-6)
+
+
+def test_tensor_lr_validates():
+    with pytest.raises(ValueError):
+        schedules.piecewise_constant_with_warmup(
+            128, 1000, boundaries=(1, 2), multipliers=(1.0, 0.1))
+
+
+def test_for_dataset_dispatch():
+    assert schedules.for_dataset("cifar10", 128, 390, 50_000) is not None
+    assert schedules.for_dataset("imagenet", 256, 500, 1_281_167) is not None
