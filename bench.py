@@ -1,0 +1,86 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput per chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's best steady-state per-GPU rate — 168.6
+images/s on a Tesla P40 under the 16-process ParameterServer run
+(BASELINE.md, ps_server/log1.log BenchmarkMetric lines).  This bench
+runs the same workload shape (ResNet-50 v1.5, 224×224, synthetic data,
+full train step incl. gradient all-reduce) on however many chips are
+attached and reports images/sec/chip.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 168.6
+
+
+def run_bench(per_chip_batch: int, warmup: int = 5, iters: int = 20):
+    from dtf_tpu.config import Config
+    from dtf_tpu.data.base import IMAGENET
+    from dtf_tpu.models import build_model
+    from dtf_tpu.runtime import initialize
+    from dtf_tpu.train import Trainer
+
+    n_chips = len(jax.devices())
+    global_batch = per_chip_batch * n_chips
+    cfg = Config(model="resnet50", dataset="imagenet", dtype="bf16",
+                 batch_size=global_batch, distribution_strategy="tpu",
+                 skip_eval=True, train_steps=1)
+    rt = initialize(cfg)
+    model, l2 = build_model("resnet50", dtype=jnp.bfloat16)
+    trainer = Trainer(cfg, rt, model, l2, IMAGENET)
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(127, 60, (global_batch, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, (global_batch,), dtype=np.int32)
+    state = trainer.init_state(jax.random.key(0), (images, labels))
+    batch = rt.shard_batch((images, labels))
+
+    # NB: sync via device_get of a non-donated output. On some remote
+    # platforms block_until_ready returns before the computation
+    # finishes; a host copy of the result cannot be faked.
+    for _ in range(warmup):
+        state, metrics = trainer.train_step(state, *batch)
+    float(jax.device_get(metrics["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = trainer.train_step(state, *batch)
+    loss = float(jax.device_get(metrics["loss"]))
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}" 
+
+    images_per_sec = global_batch * iters / elapsed
+    return images_per_sec / n_chips, n_chips
+
+
+def main():
+    for batch in (256, 128, 64):
+        try:
+            per_chip, n_chips = run_bench(batch)
+            break
+        except Exception as e:  # OOM → retry smaller
+            err = e
+            continue
+    else:
+        print(json.dumps({"metric": "resnet50_images_per_sec_per_chip",
+                          "value": 0.0, "unit": "images/sec/chip",
+                          "vs_baseline": 0.0, "error": str(err)[:200]}))
+        sys.exit(1)
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

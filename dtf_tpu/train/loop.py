@@ -85,6 +85,10 @@ class Trainer:
                 f"({runtime.num_replicas}); pick a batch size that is a "
                 f"multiple, or reduce --num_devices")
         self.steps_per_epoch = spec.num_train // self.global_batch
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"batch_size {self.global_batch} exceeds the training set "
+                f"({spec.num_train} examples): zero steps per epoch")
         self.train_epochs = cfg.train_epochs
         if cfg.train_steps:
             # reference mains: train_steps caps to 1 epoch of that length
@@ -245,7 +249,9 @@ class Trainer:
                 state, metrics = self.train_step(state, *sharded)
                 global_step += 1
                 if global_step % cfg.log_steps == 0:
-                    metrics["loss"].block_until_ready()
+                    # device_get (host copy): block_until_ready can
+                    # return early on some remote platforms
+                    jax.device_get(metrics["loss"])
                 if profiling and global_step > profile_range[1]:
                     jax.profiler.stop_trace()
                     profiling = False
@@ -275,7 +281,10 @@ class Trainer:
             jax.profiler.stop_trace()
         for cb in callbacks:
             _call(cb, "on_train_end", {"state": state, "history": history})
-        jax.block_until_ready(state.params)
+        if metrics is not None:
+            # host copy: the only reliable completion sync on platforms
+            # where block_until_ready returns early
+            jax.device_get(metrics["loss"])
         log.info("train wall time: %.1fs (%d steps)",
                  time.time() - t0, global_step)
         stats = build_stats(history, eval_output, time_cb)
