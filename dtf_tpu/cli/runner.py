@@ -38,28 +38,35 @@ def effective_global_batch(cfg: Config) -> int:
 
 
 def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
-    """Returns (train_iter_factory, eval_iter_factory)."""
+    """Returns (train_iter_factory, eval_iter_factory).
+
+    Each process produces its 1/process_count share of the global batch
+    (the loop assembles the global array from process-local shards), so
+    the per-host batch is global // process_count.
+    """
+    if global_batch % jax.process_count():
+        raise ValueError(
+            f"global batch_size {global_batch} must be divisible by the "
+            f"process count ({jax.process_count()})")
+    host_batch = global_batch // jax.process_count()
     if cfg.use_synthetic_data or not cfg.data_dir:
-        if cfg.data_dir and not cfg.use_synthetic_data:
-            pass  # fall through to real readers below
-        else:
-            return (
-                lambda: synthetic_input_fn(spec, True, global_batch, cfg.seed),
-                lambda: synthetic_input_fn(spec, False, global_batch, cfg.seed + 1),
-            )
+        return (
+            lambda: synthetic_input_fn(spec, True, host_batch, cfg.seed),
+            lambda: synthetic_input_fn(spec, False, host_batch, cfg.seed + 1),
+        )
     if spec.name == "cifar10":
         from dtf_tpu.data.cifar import cifar_input_fn
         return (
-            lambda: cifar_input_fn(cfg.data_dir, True, global_batch, seed=cfg.seed),
-            lambda: cifar_input_fn(cfg.data_dir, False, global_batch),
+            lambda: cifar_input_fn(cfg.data_dir, True, host_batch, seed=cfg.seed),
+            lambda: cifar_input_fn(cfg.data_dir, False, host_batch),
         )
     if spec.name == "imagenet":
         from dtf_tpu.data.imagenet import imagenet_input_fn
         return (
-            lambda: imagenet_input_fn(cfg.data_dir, True, global_batch,
+            lambda: imagenet_input_fn(cfg.data_dir, True, host_batch,
                                       seed=cfg.seed,
                                       num_threads=cfg.datasets_num_private_threads),
-            lambda: imagenet_input_fn(cfg.data_dir, False, global_batch),
+            lambda: imagenet_input_fn(cfg.data_dir, False, host_batch),
         )
     raise ValueError(f"no input pipeline for dataset {spec.name!r}")
 

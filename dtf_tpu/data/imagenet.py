@@ -1,0 +1,267 @@
+"""ImageNet TFRecord input pipeline.
+
+Parity with reference imagenet_preprocessing.py:
+  - shards train-%05d-of-01024 / validation-%05d-of-00128 (:144-153)
+  - Example proto fields image/encoded, image/class/label (shifted to
+    [0,1000), :254-255), image/object/bbox/{ymin,xmin,ymax,xmax}
+    (:156-223)
+  - train: sample a distorted bounding box (min_object_covered 0.1,
+    aspect ∈ [0.75, 1.33], area ∈ [0.05, 1.0], 100 attempts, whole
+    image on failure — :345-361), crop, random flip, bilinear resize to
+    224×224 (:362-372, :483-500)
+  - eval: aspect-preserving resize to shorter-side 256 then central
+    224×224 crop (:375-394, :464-480)
+  - both: channel-mean subtraction (123.68, 116.78, 103.94) without
+    scaling (:397-430)
+  - file-level shard per process, shuffle files each epoch, interleaved
+    reads, shuffle buffer 10k, multi-threaded map
+    (process_record_dataset :65-141)
+
+JPEG decode uses the native C++ library (dtf_tpu/native, libjpeg) when
+built, else PIL.  Decode+augment runs on a thread pool (the
+`datasets_num_private_threads` equivalent) feeding a bounded queue.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from dtf_tpu.data import records
+from dtf_tpu.data.pipeline import shard_for_process
+
+DEFAULT_IMAGE_SIZE = 224
+NUM_CHANNELS = 3
+NUM_TRAIN_FILES = 1024
+NUM_VAL_FILES = 128
+SHUFFLE_BUFFER = 10_000
+CHANNEL_MEANS = np.array([123.68, 116.78, 103.94], np.float32)  # R, G, B
+RESIZE_MIN = 256
+
+
+def get_filenames(is_training: bool, data_dir: str):
+    if is_training:
+        names = [os.path.join(data_dir, f"train-{i:05d}-of-01024")
+                 for i in range(NUM_TRAIN_FILES)]
+    else:
+        names = [os.path.join(data_dir, f"validation-{i:05d}-of-00128")
+                 for i in range(NUM_VAL_FILES)]
+    present = [n for n in names if os.path.exists(n)]
+    if not present:
+        raise FileNotFoundError(
+            f"no ImageNet TFRecord shards found under {data_dir}")
+    return present
+
+
+def decode_jpeg(buf: bytes) -> np.ndarray:
+    """RGB uint8 HWC decode; native lib if built, else PIL."""
+    try:
+        from dtf_tpu.native import jpeg as native_jpeg
+        return native_jpeg.decode(buf)
+    except Exception:
+        from PIL import Image
+        img = Image.open(io.BytesIO(buf))
+        if img.mode != "RGB":
+            img = img.convert("RGB")
+        return np.asarray(img, dtype=np.uint8)
+
+
+def _resize_bilinear(image: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Bilinear resize (half-pixel centers, like tf.image.resize v2)."""
+    from PIL import Image
+    return np.asarray(
+        Image.fromarray(image).resize((out_w, out_h), Image.BILINEAR),
+        dtype=np.float32)
+
+
+def sample_distorted_bbox(rng: np.random.Generator, height: int, width: int,
+                          bbox: Optional[np.ndarray],
+                          min_object_covered: float = 0.1,
+                          aspect_ratio_range=(0.75, 1.33),
+                          area_range=(0.05, 1.0),
+                          max_attempts: int = 100):
+    """Numpy re-derivation of tf.image.sample_distorted_bounding_box
+    with the reference's constants (:354-361).  Returns (y, x, h, w);
+    whole image when no attempt satisfies the constraints."""
+    if bbox is None or len(bbox) == 0:
+        bbox = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+    for _ in range(max_attempts):
+        aspect = rng.uniform(*aspect_ratio_range)
+        area_frac = rng.uniform(*area_range)
+        target_area = area_frac * height * width
+        w = int(round(np.sqrt(target_area * aspect)))
+        h = int(round(np.sqrt(target_area / aspect)))
+        if w > width or h > height or h <= 0 or w <= 0:
+            continue
+        y = rng.integers(0, height - h + 1)
+        x = rng.integers(0, width - w + 1)
+        # object coverage: fraction of a ground-truth box inside the crop
+        by0, bx0, by1, bx1 = bbox[0] * [height, width, height, width]
+        inter_h = max(0.0, min(y + h, by1) - max(y, by0))
+        inter_w = max(0.0, min(x + w, bx1) - max(x, bx0))
+        box_area = max((by1 - by0) * (bx1 - bx0), 1e-6)
+        if inter_h * inter_w / box_area >= min_object_covered:
+            return int(y), int(x), int(h), int(w)
+    return 0, 0, height, width
+
+
+def preprocess_train(buf: bytes, bbox, rng: np.random.Generator) -> np.ndarray:
+    image = decode_jpeg(buf)
+    h, w = image.shape[:2]
+    y, x, ch, cw = sample_distorted_bbox(rng, h, w, bbox)
+    cropped = image[y:y + ch, x:x + cw]
+    if rng.random() < 0.5:
+        cropped = cropped[:, ::-1]
+    out = _resize_bilinear(np.ascontiguousarray(cropped),
+                           DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE)
+    return out - CHANNEL_MEANS
+
+
+def preprocess_eval(buf: bytes) -> np.ndarray:
+    image = decode_jpeg(buf)
+    h, w = image.shape[:2]
+    # aspect-preserving resize to shorter side RESIZE_MIN (:438-480)
+    scale = RESIZE_MIN / min(h, w)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    resized = _resize_bilinear(image, nh, nw)
+    # central crop (:375-394)
+    oy = (nh - DEFAULT_IMAGE_SIZE) // 2
+    ox = (nw - DEFAULT_IMAGE_SIZE) // 2
+    crop = resized[oy:oy + DEFAULT_IMAGE_SIZE, ox:ox + DEFAULT_IMAGE_SIZE]
+    return crop - CHANNEL_MEANS
+
+
+def parse_example_record(raw: bytes):
+    """Returns (jpeg_bytes, label_int, bbox or None) — the
+    _parse_example_proto contract (:156-223)."""
+    feats = records.parse_example(raw)
+    buf = feats["image/encoded"][0]
+    label = int(np.asarray(feats["image/class/label"])[0]) - 1  # → [0,1000)
+    bbox = None
+    if "image/object/bbox/ymin" in feats and len(feats["image/object/bbox/ymin"]):
+        bbox = np.stack([
+            np.asarray(feats["image/object/bbox/ymin"], np.float32),
+            np.asarray(feats["image/object/bbox/xmin"], np.float32),
+            np.asarray(feats["image/object/bbox/ymax"], np.float32),
+            np.asarray(feats["image/object/bbox/xmax"], np.float32),
+        ], axis=1)
+    return buf, label, bbox
+
+
+def _record_stream(files, is_training: bool, rng: np.random.Generator,
+                   interleave: int = 10):
+    """File-shuffled, interleaved raw-record stream (≈ tf.data
+    interleave(cycle_length=10), :290-310)."""
+    while True:
+        order = rng.permutation(len(files)) if is_training else range(len(files))
+        readers: list = []
+        it = iter(order)
+        def refill():
+            while len(readers) < interleave:
+                try:
+                    readers.append(records.read_tfrecord_file(files[next(it)]))
+                except StopIteration:
+                    return
+        refill()
+        while readers:
+            for r in list(readers):
+                try:
+                    yield next(r)
+                except StopIteration:
+                    readers.remove(r)
+            refill()
+        if not is_training:
+            return
+
+
+def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
+                      seed: int = 0, num_threads: Optional[int] = None,
+                      process_id: Optional[int] = None,
+                      process_count: Optional[int] = None) -> Iterator:
+    """Yields (images float32 [B,224,224,3], labels int32 [B])."""
+    import jax
+    process_id = jax.process_index() if process_id is None else process_id
+    process_count = (jax.process_count() if process_count is None
+                     else process_count)
+    files = get_filenames(is_training, data_dir)
+    # shard only training files: eval must yield the same batch count on
+    # every host or the collective eval_step deadlocks (same reason the
+    # reference shards train pipelines only, cifar_preprocessing.py:147-152)
+    if is_training and process_count > 1:
+        files = shard_for_process(files, process_id, process_count) or files
+    num_threads = num_threads or min(8, (os.cpu_count() or 1) * 4)
+    rng = np.random.default_rng(seed + 7919 * process_id)
+
+    raw_q: queue.Queue = queue.Queue(maxsize=SHUFFLE_BUFFER // 4)
+    out_q: queue.Queue = queue.Queue(maxsize=64)
+    stop = threading.Event()
+
+    def reader():
+        # shuffle buffer over raw records (:114-120)
+        buffer: list = []
+        try:
+            for raw in _record_stream(files, is_training, rng):
+                if stop.is_set():
+                    return
+                if is_training:
+                    buffer.append(raw)
+                    if len(buffer) >= SHUFFLE_BUFFER:
+                        idx = rng.integers(0, len(buffer))
+                        buffer[idx], buffer[-1] = buffer[-1], buffer[idx]
+                        raw_q.put(buffer.pop())
+                else:
+                    raw_q.put(raw)
+            for raw in buffer:
+                raw_q.put(raw)
+        finally:
+            for _ in range(num_threads):
+                raw_q.put(None)
+
+    def worker(wid: int):
+        wrng = np.random.default_rng(seed + 104729 * (process_id + 1) + wid)
+        while True:
+            raw = raw_q.get()
+            if raw is None or stop.is_set():
+                out_q.put(None)
+                return
+            try:
+                buf, label, bbox = parse_example_record(raw)
+                img = (preprocess_train(buf, bbox, wrng) if is_training
+                       else preprocess_eval(buf))
+                out_q.put((img, label))
+            except Exception as e:
+                out_q.put(e)
+                return
+
+    threading.Thread(target=reader, daemon=True).start()
+    for w in range(num_threads):
+        threading.Thread(target=worker, args=(w,), daemon=True).start()
+
+    def gen():
+        images = np.empty((batch_size, DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE,
+                           NUM_CHANNELS), np.float32)
+        labels = np.empty((batch_size,), np.int32)
+        filled = 0
+        done_workers = 0
+        try:
+            while done_workers < num_threads:
+                item = out_q.get()
+                if item is None:
+                    done_workers += 1
+                    continue
+                if isinstance(item, Exception):
+                    raise item
+                images[filled], labels[filled] = item
+                filled += 1
+                if filled == batch_size:
+                    yield images.copy(), labels.copy()
+                    filled = 0
+        finally:
+            stop.set()
+
+    return gen()
