@@ -106,18 +106,34 @@ def run(cfg: Config) -> dict:
     prefetched = DevicePrefetcher(chained(), rt, buffer_size=2)
 
     callbacks = []
-    if not cfg.skip_checkpoint and cfg.model_dir and is_coordinator():
+    ckpt_mod = None
+    if (not cfg.skip_checkpoint or cfg.resume) and cfg.model_dir:
         try:
-            from dtf_tpu.train.checkpoint import CheckpointCallback
-            callbacks.append(CheckpointCallback(cfg.model_dir, trainer))
+            from dtf_tpu.train import checkpoint as ckpt_mod
         except ImportError:
-            pass
+            if cfg.resume:
+                raise ImportError(
+                    "--resume needs orbax-checkpoint; install it or drop "
+                    "the flag")
+            log.warning("checkpointing disabled: orbax-checkpoint not "
+                        "installed (pass --skip_checkpoint to silence)")
+    if ckpt_mod is not None:
+        # all processes participate (orbax coordinates the collective
+        # write of the replicated state — the rank-0-write equivalent)
+        ckpt_cb = ckpt_mod.CheckpointCallback(cfg.model_dir, trainer)
+        if cfg.resume:
+            restored = ckpt_cb.ckpt.restore(state, sharding=rt.replicated())
+            if restored is not None:
+                state = restored
+            else:
+                log.warning(
+                    "--resume: no checkpoint found under %s/checkpoints — "
+                    "training from scratch", cfg.model_dir)
+        if not cfg.skip_checkpoint:
+            callbacks.append(ckpt_cb)
     if cfg.enable_tensorboard and cfg.model_dir and is_coordinator():
-        try:
-            from dtf_tpu.utils.tensorboard import TensorBoardCallback
-            callbacks.append(TensorBoardCallback(cfg.model_dir))
-        except ImportError:
-            pass
+        from dtf_tpu.utils.tensorboard import TensorBoardCallback
+        callbacks.append(TensorBoardCallback(cfg.model_dir))
 
     state, stats = trainer.fit(
         state, prefetched,

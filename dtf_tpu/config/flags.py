@@ -80,6 +80,7 @@ class Config:
     enable_get_next_as_optional: bool = False  # partial-batch handling compat
     log_steps: int = 100                # --log_steps for BenchmarkMetric cadence
     skip_checkpoint: bool = False       # rank-0 checkpoints off (horovod mains default on)
+    resume: bool = False                # restore latest checkpoint from model_dir
 
     # --- benchmark (define_benchmark) ---
     benchmark_log_dir: str = ""         # --benchmark_log_dir

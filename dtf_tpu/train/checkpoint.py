@@ -1,0 +1,82 @@
+"""Checkpoint / resume.
+
+Reference behavior to match (SURVEY §5.4): the Horovod mains attach a
+rank-0-only per-epoch `ModelCheckpoint('./checkpoint-{epoch}.h5')`
+(resnet_imagenet_main_horovod.py:258-259) with
+BroadcastGlobalVariablesCallback(0) as the restore-consistency story.
+The reference has no resume flag; we add one (`--resume`) because on
+TPU pods restart-from-checkpoint is the whole failure-recovery story.
+
+TPU-native shape: orbax saves the full TrainState (params, batch_stats,
+optimizer velocity, step).  In multi-process runs every process calls
+save/restore collectively (orbax coordinates the write; with fully
+replicated state the writing is effectively coordinator-led, matching
+the rank-0 semantics), and the restored arrays are device_put back with
+the replicated sharding — the broadcast-equivalent.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+log = logging.getLogger("dtf_tpu")
+
+
+class Checkpointer:
+    """TrainState save/restore under <model_dir>/checkpoints."""
+
+    def __init__(self, model_dir: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(os.path.join(model_dir, "checkpoints"))
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, state, step: Optional[int] = None) -> None:
+        step = int(state.step) if step is None else int(step)
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        log.info("checkpoint saved: step %d -> %s", step, self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, abstract_state, step: Optional[int] = None,
+                sharding=None):
+        """Restores into the structure of `abstract_state` (a concrete or
+        ShapeDtypeStruct TrainState); placed with `sharding` if given —
+        restore-then-rebroadcast semantics."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          abstract_state)
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        if sharding is not None:
+            restored = jax.device_put(restored, sharding)
+        log.info("checkpoint restored: step %d from %s", step, self.directory)
+        return restored
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+class CheckpointCallback:
+    """Per-epoch save — the ModelCheckpoint-callback equivalent."""
+
+    def __init__(self, model_dir: str, trainer=None, max_to_keep: int = 3):
+        self.ckpt = Checkpointer(model_dir, max_to_keep=max_to_keep)
+
+    def on_epoch_end(self, epoch: int, logs=None):
+        if logs and "state" in logs:
+            self.ckpt.save(logs["state"])
+
+    def on_train_end(self, logs=None):
+        self.ckpt.wait()

@@ -218,7 +218,9 @@ class Trainer:
         """Runs training; returns (state, stats-dict) where the stats dict
         is key-compatible with common.build_stats output."""
         cfg = self.cfg
-        time_cb = TimeHistory(self.global_batch, cfg.log_steps)
+        resumed_step = int(jax.device_get(state.step))
+        time_cb = TimeHistory(self.global_batch, cfg.log_steps,
+                              initial_global_step=resumed_step)
         callbacks = [time_cb] + list(callbacks or [])
         acc_key = ("categorical_accuracy" if self.spec.one_hot
                    else "sparse_categorical_accuracy")
@@ -230,9 +232,13 @@ class Trainer:
             _call(cb, "on_train_begin", None)
         eval_output = None
         metrics = None
-        global_step = 0
+        global_step = resumed_step
+        start_epoch = (global_step // self.steps_per_epoch
+                       if self.steps_per_epoch else 0)
+        if start_epoch:
+            log.info("resuming at step %d (epoch %d)", global_step, start_epoch)
         t0 = time.time()
-        for epoch in range(self.train_epochs):
+        for epoch in range(start_epoch, self.train_epochs):
             for cb in callbacks:
                 _call(cb, "on_epoch_begin", epoch, None)
             for batch_idx in range(self.steps_per_epoch):

@@ -34,10 +34,11 @@ class BatchTimestamp:
 class TimeHistory:
     """Step/epoch timing with the reference's exact log cadence."""
 
-    def __init__(self, batch_size: int, log_steps: int):
+    def __init__(self, batch_size: int, log_steps: int,
+                 initial_global_step: int = 0):
         self.batch_size = batch_size      # global batch size
         self.log_steps = log_steps
-        self.global_steps = 0
+        self.global_steps = initial_global_step  # continues across resume
         self.timestamp_log = []
         self.train_finish_time: Optional[float] = None
         self._step_start: Optional[float] = None
@@ -90,7 +91,7 @@ def build_stats(history: dict, eval_output, time_callback: Optional[TimeHistory]
     if eval_output:
         stats["accuracy_top_1"] = float(eval_output[1])
         stats["eval_loss"] = float(eval_output[0])
-    if history:
+    if history and history.get("loss"):
         stats["loss"] = float(history["loss"][-1])
         for key in ("categorical_accuracy", "sparse_categorical_accuracy"):
             if key in history:
