@@ -1,0 +1,160 @@
+"""Multi-process launcher — the one-command replacement for the
+reference's deployment story.
+
+The reference needed 16 near-identical per-rank script copies plus an
+ssh fan-out loop (`ps_server/run.sh`: ssh root@host python …_ps_$i.py
+2>log$i.log &, 1 s stagger) and a pkill teardown (`kill.sh`), because
+each rank's TF_CONFIG had to be hardcoded (SURVEY §3.4, §7.9).  Here
+per-process identity is env config, so one parameterized command does
+it all:
+
+Local fan-out (all processes on this host — multi-chip hosts, or CPU
+mesh testing):
+
+    python -m dtf_tpu.cli.launch --num_processes 4 -- \
+        python -m dtf_tpu.cli.cifar_main --distribution_strategy \
+        multi_worker_mirrored ...
+
+Cluster fan-out (prints — or runs with --execute via ssh — one command
+per host; horovodrun -H parity):
+
+    python -m dtf_tpu.cli.launch --hosts h1,h2,h3,h4 -- \
+        python -m dtf_tpu.cli.imagenet_main ...
+
+Per-rank stderr/stdout goes to <log_dir>/log{rank}.log (run.sh parity).
+On any rank failing, all ranks are torn down (kill.sh parity) and the
+launcher exits non-zero.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def build_env(rank: int, world: int, coordinator: str,
+              devices_per_process: Optional[int] = None) -> dict:
+    env = dict(os.environ)
+    env["DTF_COORDINATOR"] = coordinator
+    env["DTF_PROCESS_ID"] = str(rank)
+    env["DTF_PROCESS_COUNT"] = str(world)
+    if devices_per_process:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{devices_per_process}")
+    return env
+
+
+def launch_local(cmd: List[str], num_processes: int, coordinator: str,
+                 log_dir: str, devices_per_process: Optional[int],
+                 stagger_s: float = 0.0) -> int:
+    os.makedirs(log_dir, exist_ok=True)
+    procs = []  # (rank, Popen)
+    logs = []
+    for rank in range(num_processes):
+        log_path = os.path.join(log_dir, f"log{rank}.log")
+        f = open(log_path, "wb")
+        logs.append(f)
+        p = subprocess.Popen(
+            cmd, env=build_env(rank, num_processes, coordinator,
+                               devices_per_process),
+            stdout=f, stderr=subprocess.STDOUT)
+        procs.append((rank, p))
+        if stagger_s:
+            time.sleep(stagger_s)  # run.sh's 1 s stagger, now optional
+    rc = 0
+    try:
+        while procs:
+            for rank, p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove((rank, p))
+                if ret != 0:
+                    if rc == 0:  # keep the FIRST failure's code
+                        rc = ret
+                    print(f"rank {rank} exited {ret} (see "
+                          f"{log_dir}/log{rank}.log); tearing down",
+                          file=sys.stderr)
+                    for _, q in procs:  # kill.sh parity
+                        q.send_signal(signal.SIGTERM)
+            time.sleep(0.2)
+    finally:
+        for _, q in procs:
+            q.kill()
+        for f in logs:
+            f.close()
+    return rc
+
+
+def cluster_commands(cmd: List[str], hosts: List[str], coordinator: str,
+                     log_dir: str) -> List[str]:
+    """One ssh line per host — the run.sh loop, generated."""
+    world = len(hosts)
+    quoted = " ".join(shlex.quote(c) for c in cmd)
+    lines = []
+    for rank, host in enumerate(hosts):
+        envs = (f"DTF_COORDINATOR={coordinator} DTF_PROCESS_ID={rank} "
+                f"DTF_PROCESS_COUNT={world}")
+        remote = f"{envs} {quoted} > {log_dir}/log{rank}.log 2>&1 &"
+        lines.append(f"ssh {host} {shlex.quote(remote)}")
+    return lines
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" not in argv:
+        print(__doc__)
+        return 2
+    split = argv.index("--")
+    opts, cmd = argv[:split], argv[split + 1:]
+
+    num_processes, coordinator = 1, "localhost:12346"
+    hosts: List[str] = []
+    log_dir = "./ranklogs"
+    devices_per_process: Optional[int] = None
+    execute = False
+    i = 0
+    while i < len(opts):
+        o = opts[i]
+        if o == "--num_processes":
+            num_processes = int(opts[i + 1]); i += 2
+        elif o == "--coordinator":
+            coordinator = opts[i + 1]; i += 2
+        elif o == "--hosts":
+            hosts = [h.strip() for h in opts[i + 1].split(",") if h.strip()]
+            i += 2
+        elif o == "--log_dir":
+            log_dir = opts[i + 1]; i += 2
+        elif o == "--devices_per_process":
+            devices_per_process = int(opts[i + 1]); i += 2
+        elif o == "--execute":
+            execute = True; i += 1
+        else:
+            raise ValueError(f"unknown launcher option {o}")
+
+    if hosts:
+        if coordinator == "localhost:12346":
+            coordinator = f"{hosts[0]}:12346"
+        lines = cluster_commands(cmd, hosts, coordinator, log_dir)
+        if not execute:
+            print("\n".join(lines))
+            return 0
+        running = [subprocess.Popen(line, shell=True) for line in lines]
+        rc = 0
+        for p in running:
+            ret = p.wait()
+            if ret and rc == 0:
+                rc = ret
+        return rc
+    return launch_local(cmd, num_processes, coordinator, log_dir,
+                        devices_per_process)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

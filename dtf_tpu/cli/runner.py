@@ -120,7 +120,7 @@ def run(cfg: Config) -> dict:
     if ckpt_mod is not None:
         # all processes participate (orbax coordinates the collective
         # write of the replicated state — the rank-0-write equivalent)
-        ckpt_cb = ckpt_mod.CheckpointCallback(cfg.model_dir, trainer)
+        ckpt_cb = ckpt_mod.CheckpointCallback(cfg.model_dir)
         if cfg.resume:
             restored = ckpt_cb.ckpt.restore(state, sharding=rt.replicated())
             if restored is not None:

@@ -71,7 +71,7 @@ class Checkpointer:
 class CheckpointCallback:
     """Per-epoch save — the ModelCheckpoint-callback equivalent."""
 
-    def __init__(self, model_dir: str, trainer=None, max_to_keep: int = 3):
+    def __init__(self, model_dir: str, max_to_keep: int = 3):
         self.ckpt = Checkpointer(model_dir, max_to_keep=max_to_keep)
 
     def on_epoch_end(self, epoch: int, logs=None):

@@ -285,6 +285,13 @@ class Trainer:
                              eval_output[0], eval_output[1])
         if profiling:
             jax.profiler.stop_trace()
+        if (start_epoch >= self.train_epochs and not cfg.skip_eval
+                and eval_iter_fn is not None):
+            # resumed a fully-trained checkpoint: still honor the eval ask
+            eval_output = self.evaluate(state, eval_iter_fn())
+            if eval_output and jax.process_index() == 0:
+                log.info("eval (resumed, no further training): loss=%.4f "
+                         "top1=%.4f", eval_output[0], eval_output[1])
         for cb in callbacks:
             _call(cb, "on_train_end", {"state": state, "history": history})
         if metrics is not None:

@@ -1,0 +1,81 @@
+"""True multi-process distributed tests — the coverage the reference
+never had (SURVEY §4: its multi-worker paths were only ever validated
+by manually-run cluster logs).  Two OS processes rendezvous through the
+JAX coordination service (the grpc-server/TF_CONFIG equivalent), build
+a global mesh over 2×2 virtual CPU devices, and train with cross-
+process gradient all-reduce (gloo).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import logging; logging.basicConfig(level=logging.INFO)
+from dtf_tpu.cli import run
+from dtf_tpu.config import Config, parse_flags
+import dtf_tpu.data.base as data_base
+import dataclasses
+data_base._SPECS["cifar10"] = dataclasses.replace(
+    data_base.CIFAR10, image_size=8, num_train=64, num_eval=16)
+cfg = Config(model="resnet20", dataset="cifar10", batch_size=8,
+             train_steps=2, use_synthetic_data=True, skip_eval=True,
+             skip_checkpoint=True, model_dir="", log_steps=1,
+             distribution_strategy="multi_worker_mirrored")
+from dtf_tpu.config.flags import apply_env_topology
+cfg = apply_env_topology(cfg)
+stats = run(cfg)
+print("FINAL_LOSS=%.6f" % stats["loss"])
+"""
+
+
+@pytest.mark.slow
+def test_two_process_training(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    rc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.cli.launch",
+         "--num_processes", "2", "--coordinator", "localhost:12421",
+         "--log_dir", str(tmp_path / "logs"), "--",
+         sys.executable, str(script)],
+        cwd=REPO, timeout=600, capture_output=True, text=True, env=env)
+    logs = [(tmp_path / "logs" / f"log{i}.log").read_text() for i in range(2)]
+    assert rc.returncode == 0, f"launcher failed:\n{logs[0][-2000:]}\n{logs[1][-2000:]}"
+    losses = []
+    for text in logs:
+        m = re.search(r"FINAL_LOSS=([\d.]+)", text)
+        assert m, f"no final loss in log:\n{text[-2000:]}"
+        losses.append(float(m.group(1)))
+    # both ranks computed the identical (pmean-ed, replicated) loss
+    assert abs(losses[0] - losses[1]) < 1e-6
+    # both saw the global 4-device mesh
+    assert all("data=4" in t for t in logs)
+
+
+def test_cluster_command_generation():
+    from dtf_tpu.cli.launch import cluster_commands
+    lines = cluster_commands(["python", "train.py", "--x", "1"],
+                             ["h1", "h2"], "h1:12346", "/tmp/logs")
+    assert len(lines) == 2
+    assert "DTF_PROCESS_ID=0" in lines[0] and "DTF_PROCESS_ID=1" in lines[1]
+    assert all("DTF_PROCESS_COUNT=2" in l and "ssh" in l for l in lines)
+    assert "log1.log" in lines[1]
+
+
+def test_build_env():
+    from dtf_tpu.cli.launch import build_env
+    env = build_env(3, 8, "c:1", devices_per_process=4)
+    assert env["DTF_PROCESS_ID"] == "3"
+    assert env["DTF_PROCESS_COUNT"] == "8"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
