@@ -56,19 +56,19 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
     os.makedirs(log_dir, exist_ok=True)
     procs = []  # (rank, Popen)
     logs = []
-    for rank in range(num_processes):
-        log_path = os.path.join(log_dir, f"log{rank}.log")
-        f = open(log_path, "wb")
-        logs.append(f)
-        p = subprocess.Popen(
-            cmd, env=build_env(rank, num_processes, coordinator,
-                               devices_per_process),
-            stdout=f, stderr=subprocess.STDOUT)
-        procs.append((rank, p))
-        if stagger_s:
-            time.sleep(stagger_s)  # run.sh's 1 s stagger, now optional
     rc = 0
     try:
+        for rank in range(num_processes):
+            log_path = os.path.join(log_dir, f"log{rank}.log")
+            f = open(log_path, "wb")
+            logs.append(f)
+            p = subprocess.Popen(
+                cmd, env=build_env(rank, num_processes, coordinator,
+                                   devices_per_process),
+                stdout=f, stderr=subprocess.STDOUT)
+            procs.append((rank, p))
+            if stagger_s:
+                time.sleep(stagger_s)  # run.sh's 1 s stagger, now optional
         while procs:
             for rank, p in list(procs):
                 ret = p.poll()
@@ -93,15 +93,22 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
 
 
 def cluster_commands(cmd: List[str], hosts: List[str], coordinator: str,
-                     log_dir: str) -> List[str]:
-    """One ssh line per host — the run.sh loop, generated."""
+                     log_dir: str, background: bool = True) -> List[str]:
+    """One ssh line per host — the run.sh loop, generated.
+
+    `background` appends `&` for manual copy-paste use; --execute mode
+    passes False so ssh blocks until the remote rank exits and its
+    status is observable."""
     world = len(hosts)
     quoted = " ".join(shlex.quote(c) for c in cmd)
     lines = []
     for rank, host in enumerate(hosts):
         envs = (f"DTF_COORDINATOR={coordinator} DTF_PROCESS_ID={rank} "
                 f"DTF_PROCESS_COUNT={world}")
-        remote = f"{envs} {quoted} > {log_dir}/log{rank}.log 2>&1 &"
+        remote = (f"mkdir -p {shlex.quote(log_dir)} && {envs} {quoted} "
+                  f"> {log_dir}/log{rank}.log 2>&1")
+        if background:
+            remote += " &"
         lines.append(f"ssh {host} {shlex.quote(remote)}")
     return lines
 
@@ -139,18 +146,26 @@ def main(argv=None) -> int:
             raise ValueError(f"unknown launcher option {o}")
 
     if hosts:
+        if num_processes != 1 or devices_per_process:
+            raise ValueError(
+                "--hosts runs one rank per host; --num_processes/"
+                "--devices_per_process are not supported with it")
         if coordinator == "localhost:12346":
             coordinator = f"{hosts[0]}:12346"
-        lines = cluster_commands(cmd, hosts, coordinator, log_dir)
+        lines = cluster_commands(cmd, hosts, coordinator, log_dir,
+                                 background=not execute)
         if not execute:
             print("\n".join(lines))
             return 0
+        # blocking ssh per rank: failures are observable and propagated
         running = [subprocess.Popen(line, shell=True) for line in lines]
         rc = 0
-        for p in running:
+        for rank, p in enumerate(running):
             ret = p.wait()
-            if ret and rc == 0:
-                rc = ret
+            if ret:
+                print(f"host rank {rank} exited {ret}", file=sys.stderr)
+                if rc == 0:
+                    rc = ret
         return rc
     return launch_local(cmd, num_processes, coordinator, log_dir,
                         devices_per_process)

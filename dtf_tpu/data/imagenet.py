@@ -58,17 +58,43 @@ def get_filenames(is_training: bool, data_dir: str):
     return present
 
 
+def _load_native_jpeg():
+    try:
+        from PIL import Image
+        from dtf_tpu.native import jpeg as native_jpeg
+        probe = io.BytesIO()
+        Image.new("RGB", (2, 2)).save(probe, format="JPEG")
+        if native_jpeg.shape(probe.getvalue()) != (2, 2):
+            return None
+        return native_jpeg
+    except Exception:
+        return None
+
+_native_jpeg = None
+_native_probed = False
+
+
+def native_jpeg_module():
+    global _native_jpeg, _native_probed
+    if not _native_probed:
+        _native_jpeg = _load_native_jpeg()
+        _native_probed = True
+    return _native_jpeg
+
+
 def decode_jpeg(buf: bytes) -> np.ndarray:
     """RGB uint8 HWC decode; native lib if built, else PIL."""
-    try:
-        from dtf_tpu.native import jpeg as native_jpeg
-        return native_jpeg.decode(buf)
-    except Exception:
-        from PIL import Image
-        img = Image.open(io.BytesIO(buf))
-        if img.mode != "RGB":
-            img = img.convert("RGB")
-        return np.asarray(img, dtype=np.uint8)
+    nj = native_jpeg_module()
+    if nj is not None:
+        try:
+            return nj.decode(buf)
+        except ValueError:
+            pass  # e.g. progressive/CMYK edge cases → PIL
+    from PIL import Image
+    img = Image.open(io.BytesIO(buf))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return np.asarray(img, dtype=np.uint8)
 
 
 def _resize_bilinear(image: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
@@ -111,10 +137,24 @@ def sample_distorted_bbox(rng: np.random.Generator, height: int, width: int,
 
 
 def preprocess_train(buf: bytes, bbox, rng: np.random.Generator) -> np.ndarray:
-    image = decode_jpeg(buf)
-    h, w = image.shape[:2]
-    y, x, ch, cw = sample_distorted_bbox(rng, h, w, bbox)
-    cropped = image[y:y + ch, x:x + cw]
+    nj = native_jpeg_module()
+    if nj is not None:
+        try:
+            # fused decode-and-crop: read the shape from the header, then
+            # decode only the sampled window (decode_and_crop_jpeg parity,
+            # imagenet_preprocessing.py:363-368)
+            h, w = nj.shape(buf)
+            y, x, ch, cw = sample_distorted_bbox(rng, h, w, bbox)
+            cropped = nj.decode_crop(buf, y, x, ch, cw)
+        except ValueError:
+            cropped = None
+    else:
+        cropped = None
+    if cropped is None:
+        image = decode_jpeg(buf)
+        h, w = image.shape[:2]
+        y, x, ch, cw = sample_distorted_bbox(rng, h, w, bbox)
+        cropped = image[y:y + ch, x:x + cw]
     if rng.random() < 0.5:
         cropped = cropped[:, ::-1]
     out = _resize_bilinear(np.ascontiguousarray(cropped),

@@ -66,7 +66,18 @@ def masked_crc32c(data: bytes) -> int:
 # ---------------------------------------------------------------------------
 
 def read_tfrecord_file(path: str, verify_crc: bool = False) -> Iterator[bytes]:
-    """Yields the raw serialized records of one TFRecord file."""
+    """Yields the raw serialized records of one TFRecord file.
+
+    Dispatches to the C++ reader (dtf_tpu/native) when built; the pure
+    Python below is the reference implementation and fallback."""
+    try:
+        from dtf_tpu import native
+        dispatch = native.available()
+    except Exception:  # unbuilt, unloadable (wrong arch), anything — fall back
+        dispatch = False
+    if dispatch:
+        yield from native.read_tfrecord_file(path, verify_crc)
+        return
     with open(path, "rb") as f:
         while True:
             header = f.read(12)

@@ -1,0 +1,80 @@
+"""ctypes bindings for the C++ data runtime (libdtf_native.so).
+
+Build with `make -C dtf_tpu/native`.  Every consumer degrades to the
+pure-Python implementation when the library is absent, so the build is
+an optimization, not a requirement.  ctypes foreign calls release the
+GIL, so Python worker threads get true decode parallelism.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "libdtf_native.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Returns the loaded library, or None when not built."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.dtf_crc32c.argtypes = [u8p, ctypes.c_int64]
+    lib.dtf_crc32c.restype = ctypes.c_uint32
+
+    lib.dtf_tfr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dtf_tfr_open.restype = ctypes.c_void_p
+    lib.dtf_tfr_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(u8p)]
+    lib.dtf_tfr_next.restype = ctypes.c_int64
+    lib.dtf_tfr_close.argtypes = [ctypes.c_void_p]
+
+    lib.dtf_jpeg_shape.argtypes = [u8p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_int)]
+    lib.dtf_jpeg_shape.restype = ctypes.c_int
+    lib.dtf_jpeg_decode_crop.argtypes = [
+        u8p, ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, u8p]
+    lib.dtf_jpeg_decode_crop.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def crc32c(data: bytes) -> int:
+    lib = load()
+    assert lib is not None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    return lib.dtf_crc32c(buf, len(data))
+
+
+def read_tfrecord_file(path: str, verify_crc: bool = False):
+    """Native streaming TFRecord reader; same contract as
+    records.read_tfrecord_file."""
+    lib = load()
+    assert lib is not None
+    handle = lib.dtf_tfr_open(path.encode(), int(verify_crc))
+    if not handle:
+        raise IOError(f"{path}: cannot open")
+    try:
+        data_p = ctypes.POINTER(ctypes.c_uint8)()
+        while True:
+            n = lib.dtf_tfr_next(handle, ctypes.byref(data_p))
+            if n == -1:
+                return
+            if n < 0:
+                raise IOError(f"{path}: corrupt or truncated record")
+            yield ctypes.string_at(data_p, n)
+    finally:
+        lib.dtf_tfr_close(handle)

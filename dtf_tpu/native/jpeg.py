@@ -1,0 +1,53 @@
+"""JPEG decode via the native library (libjpeg-turbo).
+
+`decode` and `decode_crop` mirror tf.image.decode_jpeg /
+decode_and_crop_jpeg (the fused op the reference leans on,
+imagenet_preprocessing.py:363-368).  ctypes calls release the GIL, so
+calling these from Python worker threads scales across cores.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from dtf_tpu.native import load
+
+
+def _lib():
+    lib = load()
+    if lib is None:
+        raise ImportError("libdtf_native.so not built; run "
+                          "`make -C dtf_tpu/native`")
+    return lib
+
+
+def shape(buf: bytes):
+    """(height, width) from the JPEG header only."""
+    lib = _lib()
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    if lib.dtf_jpeg_shape(arr, len(buf), ctypes.byref(h), ctypes.byref(w)):
+        raise ValueError("invalid JPEG")
+    return h.value, w.value
+
+
+def decode_crop(buf: bytes, y: int, x: int, ch: int, cw: int) -> np.ndarray:
+    """Fused decode-and-crop → RGB uint8 [ch, cw, 3]."""
+    lib = _lib()
+    out = np.empty((ch, cw, 3), np.uint8)
+    arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    rc = lib.dtf_jpeg_decode_crop(
+        arr, len(buf), y, x, ch, cw,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc:
+        raise ValueError(f"JPEG decode failed (rc={rc})")
+    return out
+
+
+def decode(buf: bytes) -> np.ndarray:
+    """Full-image RGB uint8 decode."""
+    h, w = shape(buf)
+    return decode_crop(buf, 0, 0, h, w)

@@ -50,8 +50,12 @@ def test_two_process_training(tmp_path):
          "--log_dir", str(tmp_path / "logs"), "--",
          sys.executable, str(script)],
         cwd=REPO, timeout=600, capture_output=True, text=True, env=env)
+    def tail(i):
+        p = tmp_path / "logs" / f"log{i}.log"
+        return p.read_text()[-2000:] if p.exists() else "<no log>"
+    assert rc.returncode == 0, (
+        f"launcher failed: {rc.stderr[-1000:]}\n{tail(0)}\n{tail(1)}")
     logs = [(tmp_path / "logs" / f"log{i}.log").read_text() for i in range(2)]
-    assert rc.returncode == 0, f"launcher failed:\n{logs[0][-2000:]}\n{logs[1][-2000:]}"
     losses = []
     for text in logs:
         m = re.search(r"FINAL_LOSS=([\d.]+)", text)
