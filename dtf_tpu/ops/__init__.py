@@ -1,0 +1,20 @@
+"""TPU kernel library (Pallas) and blockwise attention math.
+
+The reference's hot ops are third-party CUDA kernels (cuDNN conv/BN,
+SURVEY.md §2.4).  On TPU, XLA already emits MXU-tiled convolutions, so
+the kernel effort goes where XLA needs help: attention — materializing
+the [S, S] score matrix is the HBM-bandwidth trap that flash/blockwise
+attention avoids.
+"""
+
+from dtf_tpu.ops.blockwise import (NEG_INF, block_accumulate,
+                                   blockwise_attention, mha_reference)
+from dtf_tpu.ops.flash_attention import flash_attention
+
+__all__ = [
+    "NEG_INF",
+    "block_accumulate",
+    "blockwise_attention",
+    "mha_reference",
+    "flash_attention",
+]

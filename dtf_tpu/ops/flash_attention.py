@@ -1,0 +1,216 @@
+"""Flash attention for TPU — Pallas forward kernel + blockwise backward.
+
+Why a hand-written kernel when XLA fuses everything else (SURVEY.md
+§2.4 — the reference's equivalent layer is cuDNN): naive attention
+materializes the [S, S] score matrix in HBM, so at long context the op
+is HBM-bound.  The Pallas kernel keeps each [block_q, block_k] score
+tile in VMEM, carries the online-softmax state (ops.blockwise math) in
+registers/VMEM, and only ever writes the [S, D] output — turning an
+O(S²) HBM traffic op into O(S·D).
+
+Grid: (batch·heads, Sq/block_q); each program streams K/V through VMEM
+in block_k slices.  The backward pass recomputes probabilities
+blockwise from the saved log-sum-exp (the standard flash-attention
+trade: extra FLOPs for O(S²) less memory) in plain JAX, which XLA
+fuses well on TPU; a Pallas backward kernel is a further optimization,
+not a capability.
+
+On non-TPU backends `flash_attention` transparently falls back to the
+differentiable `ops.blockwise.blockwise_attention` (same math), so the
+API is portable and testable on the CPU mesh.  Pass
+``use_pallas="interpret"`` to force the kernel through the Pallas
+interpreter on CPU (used by tests to validate the kernel itself).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from dtf_tpu.ops import blockwise as bw
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k):
+    """One program: one [block_q, D] query tile vs all of K/V."""
+    block_q, head_dim = q_ref.shape
+    seq_k = k_ref.shape[0]
+    num_kv = seq_k // block_k
+    iq = pl.program_id(1)
+
+    q = q_ref[...].astype(jnp.float32)
+    o = jnp.zeros((block_q, head_dim), jnp.float32)
+    m = jnp.full((block_q,), bw.NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(j, carry):
+        o, m, l = carry
+        k = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        bias = None
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            bias = jnp.where(q_pos >= k_pos, 0.0, bw.NEG_INF)
+        return bw.block_accumulate(o, m, l, q, k, v, scale, bias)
+
+    if causal:
+        # only blocks that intersect the causal triangle contribute
+        num_kv_live = jax.lax.div(
+            (iq + 1) * block_q + block_k - 1, block_k)
+        num_kv_live = jnp.minimum(num_kv_live, num_kv)
+    else:
+        num_kv_live = num_kv
+    o, m, l = jax.lax.fori_loop(0, num_kv_live, body, (o, m, l))
+
+    o_ref[...] = bw.finalize(o, l).astype(o_ref.dtype)
+    lse = (jnp.maximum(m, bw.NEG_INF)
+           + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+    lse_ref[...] = lse[:, None]  # [block_q, 1]; see out_specs tiling note
+
+
+def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q, k, v: [BH, S, D] → (o [BH, Sq, D], lse [BH, Sq])."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (bh, sq // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            # lse kept 3-D [BH, Sq, 1]: TPU lowering requires the last
+            # two block dims to tile (8, 128) or equal the array dims;
+            # (block_q, 1) satisfies that where a 1-D (block_q,) cannot.
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    o, lse = out
+    return o, lse[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Blockwise backward (plain JAX, O(S·block) memory)
+# ---------------------------------------------------------------------------
+
+def _blockwise_bwd(q, k, v, o, lse, do, scale, causal, block_k):
+    """Standard flash-attention backward, scanning K/V blocks.
+
+    All arrays [BH, S, D] (lse [BH, Sq]) in float32.
+    """
+    sq, sk = q.shape[1], k.shape[1]
+    num_blocks = sk // block_k
+    delta = jnp.sum(do * o, axis=-1)                      # [BH, Sq]
+    q_pos = jnp.arange(sq)
+
+    kb = jnp.moveaxis(k.reshape(-1, num_blocks, block_k, k.shape[-1]), 1, 0)
+    vb = jnp.moveaxis(v.reshape(-1, num_blocks, block_k, v.shape[-1]), 1, 0)
+
+    def body(carry, blk):
+        dq, j = carry
+        kblk, vblk = blk                                   # [BH, bk, D]
+        s = jnp.einsum("bqd,bkd->bqk", q, kblk) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            s = s + bw.causal_bias(q_pos, k_pos)
+        p = jnp.exp(s - lse[..., None])                    # [BH, Sq, bk]
+        dv = jnp.einsum("bqk,bqd->bkd", p, do)
+        dp = jnp.einsum("bqd,bkd->bqk", do, vblk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kblk)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, q)
+        return (dq, j + 1), (dk, dv)
+
+    (dq, _), (dk_b, dv_b) = jax.lax.scan(
+        body, (jnp.zeros_like(q), jnp.int32(0)), (kb, vb))
+    dk = jnp.moveaxis(dk_b, 0, 1).reshape(k.shape)
+    dv = jnp.moveaxis(dv_b, 0, 1).reshape(v.shape)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing + public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _pallas_forward(q, k, v, scale, causal, block_q, block_k,
+                           interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _pallas_forward(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    f32 = lambda x: x.astype(jnp.float32)
+    dq, dk, dv = _blockwise_bwd(f32(q), f32(k), f32(v), f32(o), lse,
+                                f32(do), scale, causal, block_k)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    use_pallas=None):
+    """Multi-head attention, flash-style.  q, k, v: [B, S, H, D].
+
+    ``use_pallas``: None = auto (Pallas on TPU, blockwise-JAX
+    elsewhere); True/False = force; "interpret" = Pallas interpreter
+    (CPU kernel validation).
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if not use_pallas:
+        return bw.blockwise_attention(q, k, v, causal=causal, scale=scale,
+                                      block_k=block_k)
+
+    interpret = use_pallas == "interpret"
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = max(min(block_q, sq), 1)
+    block_k = max(min(block_k, sk), 1)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"seq lengths ({sq}, {sk}) must divide block sizes "
+            f"({block_q}, {block_k})")
+
+    def merge(x):  # [B, S, H, D] → [B·H, S, D]
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    o = _flash(merge(q), merge(k), merge(v), scale, causal, block_q,
+               block_k, interpret)
+    return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)

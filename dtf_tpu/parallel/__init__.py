@@ -1,0 +1,28 @@
+"""Parallelism library: collectives, sequence parallelism, tensor
+parallelism, and the async parameter-server mode.
+
+The reference reaches all of this through third-party native backends —
+NCCL ring allreduce, TF's grpc distributed runtime, collective-allreduce
+kernels (SURVEY.md §5.8).  Here the synchronous paths are XLA
+collectives over ICI/DCN emitted from `shard_map`/`pjit`, and the async
+parameter-server path is a first-party C++ parameter store
+(`parallel.ps`).
+"""
+
+from dtf_tpu.parallel.collectives import (all_gather, all_reduce_mean,
+                                          all_reduce_sum, axis_index,
+                                          axis_size, broadcast_from,
+                                          reduce_scatter, ring_shift)
+from dtf_tpu.parallel.ring_attention import ring_attention
+
+__all__ = [
+    "all_gather",
+    "all_reduce_mean",
+    "all_reduce_sum",
+    "axis_index",
+    "axis_size",
+    "broadcast_from",
+    "reduce_scatter",
+    "ring_shift",
+    "ring_attention",
+]

@@ -1,0 +1,78 @@
+"""Named collectives over mesh axes — the communication backend.
+
+Concept map from the reference (SURVEY.md §5.8, §2.4): every primitive
+here lowers to an XLA collective that rides ICI within a slice and DCN
+across slices; there is no first-party wire protocol to maintain, which
+is the point of the TPU-native design.
+
+  reference mechanism                     → here
+  -------------------------------------------------------------------
+  NCCL ring allreduce (Horovod)           → all_reduce_sum/mean (psum/pmean)
+  collective allreduce (--all_reduce_alg) → same; algorithm choice is
+                                            XLA's (latency-optimal on ICI)
+  hvd.BroadcastGlobalVariablesCallback(0) → broadcast_from(root=0)
+  grpc PS push/pull (async)               → parallel.ps (C++ store); the
+                                            sync SPMD reinterpretation
+                                            needs only psum
+  MPI rank / size                         → axis_index / axis_size
+
+All functions must be called inside a `shard_map`ped (or otherwise
+axis-bound) computation.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name: str):
+    """Number of shards along a mesh axis (hvd.size equivalent)."""
+    return lax.psum(1, axis_name)
+
+
+def axis_index(axis_name: str):
+    """This shard's position along a mesh axis (hvd.rank equivalent)."""
+    return lax.axis_index(axis_name)
+
+
+def all_reduce_sum(x, axis_name: str):
+    return jax.tree_util.tree_map(lambda a: lax.psum(a, axis_name), x)
+
+
+def all_reduce_mean(x, axis_name: str):
+    return jax.tree_util.tree_map(lambda a: lax.pmean(a, axis_name), x)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.tree_util.tree_map(
+        lambda a: lax.all_gather(a, axis_name, axis=axis, tiled=tiled), x)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return jax.tree_util.tree_map(
+        lambda a: lax.psum_scatter(a, axis_name, scatter_dimension=axis,
+                                   tiled=True), x)
+
+
+def ring_shift(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the axis ring: shard i → shard (i+shift)%n.
+
+    The building block of ring attention (ppermute over ICI neighbors,
+    which XLA overlaps with compute).
+    """
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree_util.tree_map(
+        lambda a: lax.ppermute(a, axis_name, perm), x)
+
+
+def broadcast_from(x, axis_name: str, root: int = 0):
+    """One-to-all broadcast along an axis (hvd broadcast equivalent)."""
+    idx = lax.axis_index(axis_name)
+
+    def bc(a):
+        masked = jax.numpy.where(idx == root, a, jax.numpy.zeros_like(a))
+        return lax.psum(masked, axis_name)
+
+    return jax.tree_util.tree_map(bc, x)

@@ -1,0 +1,102 @@
+"""Ring attention — sequence/context parallelism over the 'seq' mesh axis.
+
+Long-context capability (absent from the vision-only reference, SURVEY.md
+§5.7, but first-class here): the sequence dimension is sharded across
+devices, so context length scales linearly with the ring size instead of
+being capped by one device's HBM.
+
+Mechanics (Liu et al., Ring Attention with Blockwise Transformers): each
+device owns one query shard and one K/V shard.  The K/V shards rotate
+around the ring — `lax.ppermute` to the clockwise neighbor, which XLA
+schedules over ICI *overlapped with the attention compute of the current
+block*.  Each device folds every visiting K/V block into the
+online-softmax carry (`ops.blockwise.block_accumulate` — the same math
+as flash attention, with "block" = "shard").  After `ring_size` steps
+every query has attended to the full global sequence; no [S, S] score
+matrix and no all-gather of K/V ever materializes.
+
+Causal masking uses absolute positions derived from `axis_index`, so a
+rotating shard is masked by where it *came from*, not where it is.
+
+`ring_attention` is written to run inside `shard_map` (it is just a
+collective-using function); `ring_self_attention` wraps it over a mesh
+for direct use.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.ops import blockwise as bw
+from dtf_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def ring_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Attention over a sequence-sharded q/k/v.  Call inside shard_map.
+
+    q, k, v: [batch, seq_shard, heads, head_dim] — the local shard of a
+    globally [batch, seq, heads, head_dim] array sharded on ``axis_name``.
+    Returns the local output shard, same shape as q.
+    """
+    orig_dtype = q.dtype
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    s_loc = q.shape[-3]
+
+    to_bhsd = lambda x: jnp.swapaxes(x, -3, -2).astype(jnp.float32)
+    qt = to_bhsd(q)
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+
+    o0 = jnp.zeros_like(qt)
+    m0 = jnp.full(qt.shape[:-1], bw.NEG_INF, jnp.float32)
+    l0 = jnp.zeros(qt.shape[:-1], jnp.float32)
+
+    def body(carry, t):
+        o, m, l, kc, vc = carry
+        bias = None
+        if causal:
+            src = (idx - t) % n            # which global shard kc holds now
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            bias = bw.causal_bias(q_pos, k_pos)
+        o, m, l = bw.block_accumulate(o, m, l, qt, to_bhsd(kc), to_bhsd(vc),
+                                      scale, bias)
+        # rotate K/V to the next device; ICI neighbor exchange that XLA
+        # overlaps with the next block's compute
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    out = bw.finalize(o, l)
+    return jnp.swapaxes(out, -3, -2).astype(orig_dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = False,
+                        scale: Optional[float] = None,
+                        data_axis: str = DATA_AXIS,
+                        seq_axis: str = SEQ_AXIS,
+                        model_axis: Optional[str] = MODEL_AXIS):
+    """Ring attention over globally-shaped [B, S, H, D] arrays.
+
+    Batch shards over ``data_axis``, sequence over ``seq_axis``, heads
+    over ``model_axis`` (tensor parallelism composes freely with the
+    ring — heads never communicate).  Usable under an outer `jit`; the
+    inner shard_map is differentiable.
+    """
+    spec = P(data_axis, seq_axis, model_axis, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal,
+                scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

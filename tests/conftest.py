@@ -13,7 +13,11 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment may preset JAX_PLATFORMS to a
+# real accelerator platform, and runtime/mesh.py honors that env var —
+# tests must win or the virtual 8-device CPU mesh silently becomes a
+# 1-chip accelerator run with accelerator matmul precision.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 

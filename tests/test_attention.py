@@ -1,0 +1,154 @@
+"""Attention math: blockwise == flash == ring == plain softmax.
+
+Runs on the 8-virtual-device CPU mesh (tests/conftest.py) — the
+multi-device coverage the reference never had (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtf_tpu.ops import blockwise_attention, flash_attention, mha_reference
+from dtf_tpu.parallel.ring_attention import ring_self_attention
+from dtf_tpu.runtime.mesh import MESH_AXES
+
+B, S, H, D = 2, 64, 4, 16
+
+
+def make_qkv(seed=0, s=S):
+    rng = np.random.default_rng(seed)
+    shape = (B, s, H, D)
+    return tuple(jnp.asarray(rng.normal(size=shape).astype(np.float32))
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_reference(causal):
+    q, k, v = make_qkv()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = blockwise_attention(q, k, v, causal=causal, block_k=16)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_grads_match_reference(causal):
+    q, k, v = make_qkv(1)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    def loss_blk(q, k, v):
+        return jnp.sum(
+            blockwise_attention(q, k, v, causal=causal, block_k=16) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_blk = jax.grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_blk):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_interpret_kernel(causal):
+    """Validate the actual Pallas kernel via the interpreter."""
+    q, k, v = make_qkv(2)
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16,
+                          use_pallas="interpret")
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_pallas_interpret_grad():
+    q, k, v = make_qkv(3)
+
+    def loss_fa(q, k, v):
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                              use_pallas="interpret")
+        return jnp.sum(out ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fa, g_ref):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def _seq_mesh(seq=4, data=2, model=1):
+    devs = np.array(jax.devices()[: data * seq * model])
+    return Mesh(devs.reshape(data, seq, model), MESH_AXES)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    """4-way sequence shard × 2-way data shard on the CPU mesh."""
+    q, k, v = make_qkv(4)
+    mesh = _seq_mesh()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_grads():
+    q, k, v = make_qkv(5)
+    mesh = _seq_mesh()
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            ring_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_sharded_inputs():
+    """Inputs already placed with a seq-sharded NamedSharding: output
+    keeps the sharding and matches."""
+    q, k, v = make_qkv(6)
+    mesh = _seq_mesh()
+    sh = NamedSharding(mesh, P("data", "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_self_attention(
+        q, k, v, mesh, causal=True))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_collectives_roundtrip():
+    from dtf_tpu.parallel import (all_gather, all_reduce_mean,
+                                  broadcast_from, reduce_scatter, ring_shift)
+    mesh = _seq_mesh(seq=8, data=1)
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+
+    def f(x):
+        g = all_gather(x, "seq")            # [8,2] on every shard
+        s = reduce_scatter(g, "seq")        # back to [1,2] shards, ×8
+        shifted = ring_shift(x, "seq", 1)
+        bc = broadcast_from(x, "seq", root=0)
+        mean = all_reduce_mean(x, "seq")
+        return s, shifted, bc, mean
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=P("seq", None),
+        out_specs=(P("seq", None), P("seq", None), P("seq", None), P(None)),
+        check_vma=False))
+    s, shifted, bc, mean = fn(x)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x) * 8)
+    np.testing.assert_allclose(np.asarray(shifted),
+                               np.roll(np.asarray(x), 1, axis=0))
+    np.testing.assert_allclose(np.asarray(bc),
+                               np.tile(np.asarray(x)[:1], (8, 1)))
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(x).mean(0, keepdims=True))
