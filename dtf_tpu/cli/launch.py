@@ -105,8 +105,9 @@ def cluster_commands(cmd: List[str], hosts: List[str], coordinator: str,
     for rank, host in enumerate(hosts):
         envs = (f"DTF_COORDINATOR={coordinator} DTF_PROCESS_ID={rank} "
                 f"DTF_PROCESS_COUNT={world}")
+        logfile = shlex.quote(f"{log_dir}/log{rank}.log")
         remote = (f"mkdir -p {shlex.quote(log_dir)} && {envs} {quoted} "
-                  f"> {log_dir}/log{rank}.log 2>&1")
+                  f"> {logfile} 2>&1")
         if background:
             remote += " &"
         lines.append(f"ssh {host} {shlex.quote(remote)}")

@@ -27,7 +27,9 @@ def load() -> Optional[ctypes.CDLL]:
     lib = ctypes.CDLL(_LIB_PATH)
     u8p = ctypes.POINTER(ctypes.c_uint8)
 
-    lib.dtf_crc32c.argtypes = [u8p, ctypes.c_int64]
+    # Input buffers are declared c_char_p so Python `bytes` pass
+    # zero-copy (the C side is const and never writes).
+    lib.dtf_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.dtf_crc32c.restype = ctypes.c_uint32
 
     lib.dtf_tfr_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -36,14 +38,19 @@ def load() -> Optional[ctypes.CDLL]:
     lib.dtf_tfr_next.restype = ctypes.c_int64
     lib.dtf_tfr_close.argtypes = [ctypes.c_void_p]
 
-    lib.dtf_jpeg_shape.argtypes = [u8p, ctypes.c_int64,
+    lib.dtf_jpeg_shape.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                    ctypes.POINTER(ctypes.c_int),
                                    ctypes.POINTER(ctypes.c_int)]
     lib.dtf_jpeg_shape.restype = ctypes.c_int
     lib.dtf_jpeg_decode_crop.argtypes = [
-        u8p, ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, u8p]
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, u8p]
     lib.dtf_jpeg_decode_crop.restype = ctypes.c_int
+    lib.dtf_jpeg_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_int, u8p, ctypes.c_int]
+    lib.dtf_jpeg_decode_batch.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -55,8 +62,7 @@ def available() -> bool:
 def crc32c(data: bytes) -> int:
     lib = load()
     assert lib is not None
-    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-    return lib.dtf_crc32c(buf, len(data))
+    return lib.dtf_crc32c(data, len(data))
 
 
 def read_tfrecord_file(path: str, verify_crc: bool = False):

@@ -12,6 +12,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <mutex>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -28,10 +29,12 @@ extern "C" {
 // ---------------------------------------------------------------------------
 
 static uint32_t crc_table[8][256];
-static bool crc_init_done = false;
 
-static void crc_init() {
-  if (crc_init_done) return;
+// Built once under std::call_once: callers arrive from Python threads
+// with the GIL released, so first use may be concurrent.
+static std::once_flag crc_once;
+
+static void crc_build_tables() {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
     for (int k = 0; k < 8; k++) c = (c >> 1) ^ (0x82F63B78u * (c & 1));
@@ -44,8 +47,9 @@ static void crc_init() {
       crc_table[s][i] = c;
     }
   }
-  crc_init_done = true;
 }
+
+static void crc_init() { std::call_once(crc_once, crc_build_tables); }
 
 uint32_t dtf_crc32c(const uint8_t* data, int64_t n) {
   crc_init();
@@ -102,6 +106,10 @@ int64_t dtf_tfr_next(void* handle, const uint8_t** data) {
     memcpy(&crc, header + 8, 4);
     if (masked_crc(header, 8) != crc) return -2;
   }
+  // The length field is untrusted file content: a corrupt header must
+  // surface as a catchable read error, not a std::bad_alloc (or a
+  // len+4 wraparound) escaping through the C ABI.
+  if (len > (1ull << 33)) return -2;  // 8 GiB: far beyond any real record
   r->buf.resize(len + 4);
   if (fread(r->buf.data(), 1, len + 4, r->f) != len + 4) return -2;
   if (r->verify) {

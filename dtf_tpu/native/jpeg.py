@@ -28,8 +28,7 @@ def shape(buf: bytes):
     lib = _lib()
     h = ctypes.c_int()
     w = ctypes.c_int()
-    arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
-    if lib.dtf_jpeg_shape(arr, len(buf), ctypes.byref(h), ctypes.byref(w)):
+    if lib.dtf_jpeg_shape(buf, len(buf), ctypes.byref(h), ctypes.byref(w)):
         raise ValueError("invalid JPEG")
     return h.value, w.value
 
@@ -38,9 +37,8 @@ def decode_crop(buf: bytes, y: int, x: int, ch: int, cw: int) -> np.ndarray:
     """Fused decode-and-crop → RGB uint8 [ch, cw, 3]."""
     lib = _lib()
     out = np.empty((ch, cw, 3), np.uint8)
-    arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
     rc = lib.dtf_jpeg_decode_crop(
-        arr, len(buf), y, x, ch, cw,
+        buf, len(buf), y, x, ch, cw,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     if rc:
         raise ValueError(f"JPEG decode failed (rc={rc})")
@@ -51,3 +49,27 @@ def decode(buf: bytes) -> np.ndarray:
     """Full-image RGB uint8 decode."""
     h, w = shape(buf)
     return decode_crop(buf, 0, 0, h, w)
+
+
+def decode_batch(bufs, crops, ch: int, cw: int,
+                 num_threads: int = 4) -> np.ndarray:
+    """Decode-and-crop ``len(bufs)`` JPEGs in parallel C++ threads.
+
+    ``crops``: sequence of (y, x, h, w) per image, with h == ch and
+    w == cw (one fixed output geometry per batch — the training path's
+    shape anyway).  Returns uint8 [n, ch, cw, 3]; raises on any failed
+    image.
+    """
+    lib = _lib()
+    n = len(bufs)
+    out = np.empty((n, ch, cw, 3), np.uint8)
+    buf_ptrs = (ctypes.c_char_p * n)(*bufs)
+    lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
+    crop_arr = (ctypes.c_int * (4 * n))(
+        *[int(v) for c in crops for v in c])
+    failures = lib.dtf_jpeg_decode_batch(
+        buf_ptrs, lens, n, crop_arr, ch, cw,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), num_threads)
+    if failures:
+        raise ValueError(f"{failures}/{n} JPEGs failed to decode")
+    return out

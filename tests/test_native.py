@@ -87,3 +87,35 @@ def test_jpeg_crop_out_of_bounds():
     buf = _jpeg(rng.integers(0, 256, (32, 32, 3), dtype=np.uint8))
     with pytest.raises(ValueError):
         jpeg.decode_crop(buf, 0, 0, 64, 64)
+
+
+def test_jpeg_decode_batch_matches_single():
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(7)
+    bufs, crops = [], []
+    for i in range(6):
+        h, w = 40 + i, 50 + i
+        bufs.append(_jpeg(rng.integers(0, 256, (h, w, 3), dtype=np.uint8)))
+        crops.append((i % 3, i % 2, 32, 32))
+    batch = jpeg.decode_batch(bufs, crops, 32, 32, num_threads=3)
+    assert batch.shape == (6, 32, 32, 3)
+    for i, (buf, (y, x, ch, cw)) in enumerate(zip(bufs, crops)):
+        single = jpeg.decode_crop(buf, y, x, ch, cw)
+        np.testing.assert_array_equal(batch[i], single)
+
+
+def test_jpeg_decode_batch_reports_failures():
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(8)
+    good = _jpeg(rng.integers(0, 256, (40, 40, 3), dtype=np.uint8))
+    with pytest.raises(ValueError):
+        jpeg.decode_batch([good, b"not a jpeg"], [(0, 0, 32, 32)] * 2, 32, 32)
+
+
+def test_tfrecord_reader_rejects_absurd_length(tmp_path):
+    """A corrupt length field must raise, not abort the process."""
+    path = str(tmp_path / "huge.tfrecord")
+    with open(path, "wb") as f:
+        f.write((1 << 62).to_bytes(8, "little") + b"\x00" * 4)
+    with pytest.raises(IOError):
+        list(native.read_tfrecord_file(path, verify_crc=False))
