@@ -25,15 +25,17 @@ from dtf_tpu.train import Trainer
 log = logging.getLogger("dtf_tpu")
 
 
-def effective_global_batch(cfg: Config) -> int:
+def effective_global_batch(cfg: Config, runtime) -> int:
     """Batch-size semantics across strategies (SURVEY §3.3/§3.4):
     mirrored/MWM treat --batch_size as global (Keras-fit semantics);
-    horovod/parameter_server treat it as per-process (each reference
-    rank ran its own fit with steps//size), so the global batch scales
-    with process count — which also reproduces the hvd.size() LR
-    scaling, since LR scales linearly with the global batch."""
+    horovod/parameter_server treat it as per-replica — each reference
+    rank drove exactly one GPU with its own --batch_size, so the global
+    batch is batch × hvd.size() ≡ batch × num_replicas.  Scaling by
+    replicas (not processes) keeps the horovod LR rule consistent when
+    one process drives several chips: LR ramps to 0.1 × num_replicas
+    and the batch scales by the same factor."""
     if cfg.distribution_strategy in ("horovod", "parameter_server"):
-        return cfg.batch_size * jax.process_count()
+        return cfg.batch_size * runtime.num_replicas
     return cfg.batch_size
 
 
@@ -72,6 +74,11 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
 
 
 def run(cfg: Config) -> dict:
+    export_model = None
+    if cfg.export_dir:
+        # fail fast: don't discover a missing orbax install only after
+        # training completes
+        from dtf_tpu.train.checkpoint import export_model
     if cfg.clean and cfg.model_dir and os.path.isdir(cfg.model_dir):
         # model_helpers.apply_clean parity (resnet_imagenet_main.py:275)
         shutil.rmtree(cfg.model_dir, ignore_errors=True)
@@ -84,7 +91,7 @@ def run(cfg: Config) -> dict:
         import dataclasses
         spec = dataclasses.replace(spec, num_classes=cfg.num_classes)
 
-    global_batch = effective_global_batch(cfg)
+    global_batch = effective_global_batch(cfg, rt)
     cfg = cfg.replace(batch_size=global_batch)
 
     model_name = "trivial" if cfg.use_trivial_model else cfg.model
@@ -135,10 +142,22 @@ def run(cfg: Config) -> dict:
         from dtf_tpu.utils.tensorboard import TensorBoardCallback
         callbacks.append(TensorBoardCallback(cfg.model_dir))
 
-    state, stats = trainer.fit(
-        state, prefetched,
-        eval_iter_fn=None if cfg.skip_eval else eval_fn,
-        callbacks=callbacks)
+    # logger.benchmark_context parity (resnet_cifar_main.py:234)
+    from dtf_tpu.utils.benchmark_logger import benchmark_context
+    with benchmark_context(cfg) as bench_log:
+        state, stats = trainer.fit(
+            state, prefetched,
+            eval_iter_fn=None if cfg.skip_eval else eval_fn,
+            callbacks=callbacks)
+        if bench_log is not None:
+            bench_log.log_stats(stats,
+                                global_step=int(jax.device_get(state.step)))
+
+    if export_model is not None:
+        # --export_dir parity: final inference variables, written once
+        # (replicated state ⇒ the collective write is coordinator-led)
+        export_model(cfg.export_dir, state)
+
     log.info("Run stats: %s",
              {k: v for k, v in stats.items() if k != "step_timestamp_log"})
     return stats

@@ -110,7 +110,11 @@ int64_t dtf_tfr_next(void* handle, const uint8_t** data) {
   // surface as a catchable read error, not a std::bad_alloc (or a
   // len+4 wraparound) escaping through the C ABI.
   if (len > (1ull << 33)) return -2;  // 8 GiB: far beyond any real record
-  r->buf.resize(len + 4);
+  try {
+    r->buf.resize(len + 4);
+  } catch (const std::bad_alloc&) {
+    return -2;  // corrupt length below the cap but beyond available memory
+  }
   if (fread(r->buf.data(), 1, len + 4, r->f) != len + 4) return -2;
   if (r->verify) {
     uint32_t crc;

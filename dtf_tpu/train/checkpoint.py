@@ -68,6 +68,31 @@ class Checkpointer:
         self._mgr.close()
 
 
+def export_model(export_dir: str, state) -> str:
+    """--export_dir parity (flags_core.define_base): write the final
+    inference variables (params + batch_stats, no optimizer state) as a
+    standalone orbax checkpoint — the SavedModel-export equivalent.
+    Returns the written path."""
+    path = os.path.abspath(os.path.join(export_dir, "model"))
+    ckptr = ocp.StandardCheckpointer()
+    payload = {"params": state.params, "batch_stats": state.batch_stats}
+    ckptr.save(path, payload, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    log.info("model exported to %s", path)
+    return path
+
+
+def load_exported_model(export_dir: str) -> dict:
+    """Restore variables written by `export_model` (for serving/tests)."""
+    path = os.path.abspath(os.path.join(export_dir, "model"))
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(path)
+    finally:
+        ckptr.close()
+
+
 class CheckpointCallback:
     """Per-epoch save — the ModelCheckpoint-callback equivalent."""
 

@@ -96,9 +96,17 @@ class Trainer:
             self.train_epochs = 1
         self.eval_steps = spec.num_eval // self.global_batch
 
-        self.schedule = schedule or sched_lib.for_dataset(
-            spec.name, self.global_batch, max(self.steps_per_epoch, 1),
-            spec.num_train, use_tensor_lr=cfg.use_tensor_lr)
+        if schedule is not None:
+            self.schedule = schedule
+        elif cfg.distribution_strategy == "horovod":
+            # horovod-parity: constant size-scaled LR with 3-epoch warmup
+            # replaces the piecewise schedule (SURVEY §3.3)
+            self.schedule = sched_lib.horovod_schedule(
+                runtime.num_replicas, max(self.steps_per_epoch, 1))
+        else:
+            self.schedule = sched_lib.for_dataset(
+                spec.name, self.global_batch, max(self.steps_per_epoch, 1),
+                spec.num_train, use_tensor_lr=cfg.use_tensor_lr)
         self.tx = keras_sgd(self.schedule, momentum=0.9)
         self.loss_scale = cfg.loss_scale_value
 
@@ -283,6 +291,16 @@ class Trainer:
                 if eval_output and jax.process_index() == 0:
                     log.info("eval: loss=%.4f top1=%.4f",
                              eval_output[0], eval_output[1])
+                # --stop_threshold parity (model_helpers.past_stop_threshold
+                # via flags_core.define_base): end training once eval top-1
+                # reaches the threshold
+                if (eval_output and cfg.stop_threshold is not None
+                        and eval_output[1] >= cfg.stop_threshold):
+                    if jax.process_index() == 0:
+                        log.info("stop_threshold %.4f reached (top1=%.4f) — "
+                                 "stopping early at epoch %d",
+                                 cfg.stop_threshold, eval_output[1], epoch + 1)
+                    break
         if profiling:
             jax.profiler.stop_trace()
         if (start_epoch >= self.train_epochs and not cfg.skip_eval

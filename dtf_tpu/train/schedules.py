@@ -114,6 +114,25 @@ def piecewise_constant_with_warmup(batch_size: int, epoch_size: int,
     return fn
 
 
+def horovod_schedule(num_replicas: int, steps_per_epoch: int,
+                     warmup_epochs: float = 3.0,
+                     base_lr: float = BASE_LEARNING_RATE) -> Schedule:
+    """Horovod-parity LR: the reference's horovod mains drop the
+    piecewise schedule entirely and run a constant ``0.1 * hvd.size()``
+    (resnet_cifar_main_horovod.py:164) ramped by
+    ``LearningRateWarmupCallback(warmup_epochs=3)`` — a linear climb
+    from the unscaled base LR to the size-scaled LR over the first three
+    epochs (:229-232)."""
+    scaled = base_lr * num_replicas
+
+    def fn(step):
+        step = step.astype(jnp.float32)
+        frac = jnp.minimum(step / (warmup_epochs * steps_per_epoch), 1.0)
+        return jnp.float32(base_lr) + (scaled - base_lr) * frac
+
+    return fn
+
+
 def constant(lr: float) -> Schedule:
     def fn(step):
         return jnp.float32(lr)

@@ -1,0 +1,118 @@
+"""Benchmark run/metric file logging.
+
+Parity target: `official.utils.logs.logger.benchmark_context(FLAGS)`
+(reference resnet_cifar_main.py:234, SURVEY §5.5c) — when
+`--benchmark_log_dir` is set, the run is wrapped in a context that
+writes two files the benchmark infrastructure consumes:
+
+  benchmark_run.log — one JSON object of run metadata (model, dataset,
+      run parameters, machine info, run date, test id)
+  metric.log        — one JSON line per recorded metric:
+      {"name", "value", "unit", "global_step", "timestamp", "extras"}
+
+With no log dir the context is a no-op, matching the reference's
+BaseBenchmarkLogger fallback.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime
+import json
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("dtf_tpu")
+
+_RUN_FILE = "benchmark_run.log"
+_METRIC_FILE = "metric.log"
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+class BenchmarkFileLogger:
+    """Writes benchmark_run.log + metric.log under `log_dir`."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = os.path.abspath(log_dir)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._metric_path = os.path.join(self.log_dir, _METRIC_FILE)
+
+    def log_run_info(self, model_name: str, dataset_name: str,
+                     run_params: dict, test_id: str = "") -> None:
+        devices = jax.devices()
+        info = {
+            "model_name": model_name,
+            "dataset": {"name": dataset_name},
+            "machine_config": {
+                "platform": devices[0].platform if devices else "unknown",
+                "device_kind": devices[0].device_kind if devices else "unknown",
+                "device_count": len(devices),
+                "process_count": jax.process_count(),
+            },
+            "run_date": _utcnow(),
+            "jax_version": {"version": jax.__version__},
+            "run_parameters": _jsonable(run_params),
+            "test_id": test_id or None,
+        }
+        path = os.path.join(self.log_dir, _RUN_FILE)
+        with open(path, "w") as f:
+            json.dump(info, f, indent=2)
+            f.write("\n")
+
+    def log_metric(self, name: str, value, unit: Optional[str] = None,
+                   global_step: Optional[int] = None,
+                   extras: Optional[dict] = None) -> None:
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            log.warning("benchmark metric %r has non-numeric value %r — "
+                        "skipped", name, value)
+            return
+        record = {
+            "name": name,
+            "value": value,
+            "unit": unit,
+            "global_step": global_step,
+            "timestamp": _utcnow(),
+            "extras": [{"name": k, "value": str(v)}
+                       for k, v in (extras or {}).items()],
+        }
+        with open(self._metric_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def log_stats(self, stats: dict, global_step: Optional[int] = None) -> None:
+        """Record a run's final stats dict (build_stats output) as metrics."""
+        for key in ("loss", "training_accuracy_top_1", "accuracy_top_1",
+                    "eval_loss", "avg_exp_per_second"):
+            if key in stats and stats[key] is not None:
+                self.log_metric(key, stats[key], global_step=global_step)
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _jsonable(v) for k, v in obj.items()}
+        return str(obj)
+
+
+@contextlib.contextmanager
+def benchmark_context(cfg):
+    """Wraps a run: yields a BenchmarkFileLogger (or None when
+    benchmark logging is off / this is not the coordinator process)."""
+    if cfg.benchmark_log_dir and jax.process_index() == 0:
+        logger = BenchmarkFileLogger(cfg.benchmark_log_dir)
+        logger.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
+                            test_id=cfg.benchmark_test_id)
+        yield logger
+    else:
+        yield None

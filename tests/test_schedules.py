@@ -94,3 +94,16 @@ def test_tensor_lr_validates():
 def test_for_dataset_dispatch():
     assert schedules.for_dataset("cifar10", 128, 390, 50_000) is not None
     assert schedules.for_dataset("imagenet", 256, 500, 1_281_167) is not None
+
+
+def test_horovod_schedule_warmup_and_plateau():
+    """LearningRateWarmupCallback(3) parity: base LR at step 0, linear
+    climb to 0.1*size by epoch 3, constant after
+    (resnet_cifar_main_horovod.py:164,229-232)."""
+    size, spe = 16, 100
+    fn = schedules.horovod_schedule(size, spe)
+    assert float(fn(jnp.asarray(0))) == pytest.approx(0.1)
+    mid = float(fn(jnp.asarray(int(1.5 * spe))))
+    assert mid == pytest.approx(0.1 + (0.1 * size - 0.1) * 0.5)
+    for step in (3 * spe, 5 * spe, 100 * spe):
+        assert float(fn(jnp.asarray(step))) == pytest.approx(0.1 * size)

@@ -159,3 +159,60 @@ def test_train_steps_cap():
     tr = Trainer(cfg, rt, model, l2, TINY_CIFAR)
     assert tr.steps_per_epoch == 3
     assert tr.train_epochs == 1
+
+
+def test_stop_threshold_early_stop(caplog):
+    """--stop_threshold parity: training halts once eval top-1 passes
+    the threshold (threshold 0.0 ⇒ stop after the first eval epoch)."""
+    import logging
+    cfg = base_cfg(skip_eval=False, train_steps=None, train_epochs=3,
+                   stop_threshold=0.0, epochs_between_evals=1)
+    with caplog.at_level(logging.INFO, logger="dtf_tpu"):
+        stats = run(cfg)
+    check_stats(stats, eval_ran=True)
+    assert any("stop_threshold" in r.message for r in caplog.records)
+
+
+def test_export_dir_roundtrip(tmp_path):
+    """--export_dir parity: final inference variables written and
+    restorable."""
+    from dtf_tpu.train.checkpoint import load_exported_model
+    export_dir = str(tmp_path / "export")
+    run(base_cfg(export_dir=export_dir))
+    restored = load_exported_model(export_dir)
+    assert "params" in restored and restored["params"]
+    assert "batch_stats" in restored
+
+
+def test_benchmark_log_dir(tmp_path):
+    """logger.benchmark_context parity: benchmark_run.log metadata +
+    metric.log JSON lines."""
+    import json
+    log_dir = str(tmp_path / "bench")
+    run(base_cfg(benchmark_log_dir=log_dir, benchmark_test_id="t1"))
+    with open(f"{log_dir}/benchmark_run.log") as f:
+        info = json.load(f)
+    assert info["model_name"] == "resnet20"
+    assert info["dataset"]["name"] == "cifar10"
+    assert info["test_id"] == "t1"
+    assert info["machine_config"]["device_count"] >= 1
+    with open(f"{log_dir}/metric.log") as f:
+        metrics = [json.loads(line) for line in f]
+    names = {m["name"] for m in metrics}
+    assert "loss" in names and "training_accuracy_top_1" in names
+    assert all(isinstance(m["value"], float) for m in metrics)
+
+
+def test_horovod_lr_schedule_selected():
+    """Horovod mode uses the constant size-scaled warmup LR, not the
+    piecewise schedule."""
+    from dtf_tpu.models import build_model
+    from dtf_tpu.runtime import initialize
+    from dtf_tpu.train import Trainer
+    import jax.numpy as jnp
+    cfg = base_cfg(distribution_strategy="horovod")
+    rt = initialize(cfg)
+    model, l2 = build_model("resnet20")
+    tr = Trainer(cfg, rt, model, l2, TINY_CIFAR)
+    big_step = jnp.asarray(10_000)
+    assert float(tr.schedule(big_step)) == pytest.approx(0.1 * rt.num_replicas)
