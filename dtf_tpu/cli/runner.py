@@ -84,6 +84,12 @@ def run(cfg: Config) -> dict:
         shutil.rmtree(cfg.model_dir, ignore_errors=True)
     if cfg.model_dir:
         os.makedirs(cfg.model_dir, exist_ok=True)
+    if cfg.distribution_strategy == "parameter_server" and cfg.ps_mode == "async":
+        # true-async push/pull against the C++ parameter store; no mesh,
+        # no collective rendezvous — each worker steps independently
+        # (SURVEY §3.4 semantics)
+        from dtf_tpu.parallel import ps
+        return ps.run_async(cfg)
 
     rt = initialize(cfg)
     spec = get_dataset_spec(cfg.dataset)

@@ -93,6 +93,9 @@ class Config:
 
     # --- distribution / topology (TF_CONFIG successor) ---
     distribution_strategy: str = "mirrored"  # --distribution_strategy
+    ps_mode: str = "sync"               # parameter_server flavor: sync SPMD
+                                        # (north star) | async (C++ param
+                                        # store, capability-exact, parallel/ps)
     num_devices: Optional[int] = None   # ≈ --num_gpus: local chips to use; None = all
     worker_hosts: Optional[str] = None  # --worker_hosts "h1:p,h2:p" (imagenet_main.py:108-110)
     task_index: int = -1                # --task_index
@@ -116,6 +119,9 @@ class Config:
                 f"choose from {STRATEGIES}")
         if self.dtype not in DTYPES:
             raise ValueError(f"unknown dtype {self.dtype!r}; choose from {DTYPES}")
+        if self.ps_mode not in ("sync", "async"):
+            raise ValueError(
+                f"unknown ps_mode {self.ps_mode!r}; choose sync or async")
 
     # -- dtype helpers -------------------------------------------------
     @property

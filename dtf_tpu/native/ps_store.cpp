@@ -1,0 +1,327 @@
+// Async parameter-store server — the native core of the opt-in
+// asynchronous parameter_server mode.
+//
+// The reference's PS path delegates this to the TensorFlow C++ grpc
+// distributed runtime: a PS rank hosts the variables and serves
+// push/pull forever while workers step asynchronously (reference
+// ps_server/resnet_imagenet_main_dist_ps_0.py:38-50, log evidence
+// "Started server with target: grpc://localhost:1111", SURVEY §3.4).
+// This is the TPU-native framework's equivalent: a small threaded TCP
+// server holding the flat parameter vector plus Keras-SGD momentum
+// slots (velocity lives on the PS, like TF optimizer slot variables),
+// applying pushed gradients under a mutex — i.e. HogWild-style async
+// SGD with atomic-per-push updates, the same consistency model the
+// reference's PS gives per-variable.
+//
+// Wire protocol (little-endian, length-free framing by fixed headers):
+//   request  = u8 opcode, then opcode-specific payload
+//   INIT=1   : u64 n, f32[n] params        -> u8 st, u64 n, u64 version
+//              (first INIT wins; st=1 when already initialized)
+//   PULL=2   :                              -> u8 st, u64 n, u64 version, f32[n]
+//              (st=2 when not yet initialized; no payload then)
+//   PUSH=3   : f32 lr, u64 n, f32[n] grads -> u8 st, u64 version
+//              (v = momentum*v - lr*g; p += v  — Keras SGD form)
+//   INFO=4   :                              -> u8 st, u64 n, u64 version
+//   DONE=5   :                              -> u8 st   (worker finished)
+//   SHUTDOWN=6:                             -> u8 st   (server exits)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_INIT = 1,
+  OP_PULL = 2,
+  OP_PUSH = 3,
+  OP_INFO = 4,
+  OP_DONE = 5,
+  OP_SHUTDOWN = 6,
+};
+
+// Parameters larger than this are a corrupt/hostile request, not a real
+// model (4B f32 = 16 GiB).
+constexpr uint64_t kMaxParams = 1ull << 32;
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t got = recv(fd, p, n, 0);
+    if (got <= 0) return false;
+    p += got;
+    n -= static_cast<size_t>(got);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t put = send(fd, p, n, MSG_NOSIGNAL);
+    if (put <= 0) return false;
+    p += put;
+    n -= static_cast<size_t>(put);
+  }
+  return true;
+}
+
+struct PsServer {
+  int listen_fd = -1;
+  int port = 0;
+  float momentum = 0.9f;
+
+  std::mutex mu;                 // guards params/velocity/version
+  std::vector<float> params;
+  std::vector<float> velocity;
+  uint64_t version = 0;
+  bool initialized = false;
+
+  std::mutex state_mu;           // guards done_count/stopping + cv
+  std::condition_variable cv;
+  int done_count = 0;
+  bool stopping = false;
+
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;     // shut down on stop so joins can't hang
+  std::mutex threads_mu;
+
+  void handle_conn(int fd);
+  void accept_loop();
+};
+
+void PsServer::handle_conn(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::vector<float> scratch;
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    if (op == OP_INIT) {
+      uint64_t n;
+      if (!read_full(fd, &n, 8) || n == 0 || n > kMaxParams) break;
+      // a hostile/corrupt n below the cap must drop this connection,
+      // not std::terminate the store hosting every worker's state
+      try {
+        scratch.resize(n);
+      } catch (const std::bad_alloc&) {
+        break;
+      }
+      if (!read_full(fd, scratch.data(), n * 4)) break;
+      uint8_t st = 0;
+      uint64_t ver, outn;
+      bool alloc_failed = false;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (!initialized) {
+          try {
+            params = scratch;
+            velocity.assign(n, 0.0f);
+            initialized = true;
+          } catch (const std::bad_alloc&) {
+            params.clear();
+            velocity.clear();
+            alloc_failed = true;
+          }
+        } else {
+          st = 1;
+        }
+        ver = version;
+        outn = params.size();
+      }
+      if (alloc_failed) break;
+      uint8_t resp[17];
+      resp[0] = st;
+      memcpy(resp + 1, &outn, 8);
+      memcpy(resp + 9, &ver, 8);
+      if (!write_full(fd, resp, 17)) break;
+    } else if (op == OP_PULL) {
+      std::unique_lock<std::mutex> lk(mu);
+      if (!initialized) {
+        lk.unlock();
+        uint8_t st = 2;
+        if (!write_full(fd, &st, 1)) break;
+        continue;
+      }
+      // snapshot under the lock, send outside it
+      scratch = params;
+      uint64_t ver = version, n = scratch.size();
+      lk.unlock();
+      uint8_t hdr[17];
+      hdr[0] = 0;
+      memcpy(hdr + 1, &n, 8);
+      memcpy(hdr + 9, &ver, 8);
+      if (!write_full(fd, hdr, 17)) break;
+      if (!write_full(fd, scratch.data(), n * 4)) break;
+    } else if (op == OP_PUSH) {
+      float lr;
+      uint64_t n;
+      if (!read_full(fd, &lr, 4) || !read_full(fd, &n, 8) ||
+          n == 0 || n > kMaxParams)
+        break;
+      try {
+        scratch.resize(n);
+      } catch (const std::bad_alloc&) {
+        break;
+      }
+      if (!read_full(fd, scratch.data(), n * 4)) break;
+      uint8_t st = 0;
+      uint64_t ver = 0;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (!initialized || params.size() != n) {
+          st = 2;
+        } else {
+          float* p = params.data();
+          float* v = velocity.data();
+          const float* g = scratch.data();
+          const float m = momentum;
+          for (uint64_t i = 0; i < n; ++i) {
+            v[i] = m * v[i] - lr * g[i];
+            p[i] += v[i];
+          }
+          ver = ++version;
+        }
+      }
+      uint8_t resp[9];
+      resp[0] = st;
+      memcpy(resp + 1, &ver, 8);
+      if (!write_full(fd, resp, 9)) break;
+    } else if (op == OP_INFO) {
+      uint8_t resp[17];
+      std::lock_guard<std::mutex> lk(mu);
+      uint64_t n = params.size(), ver = version;
+      resp[0] = initialized ? 0 : 2;
+      memcpy(resp + 1, &n, 8);
+      memcpy(resp + 9, &ver, 8);
+      if (!write_full(fd, resp, 17)) break;
+    } else if (op == OP_DONE) {
+      // ack BEFORE notifying: wait() returning triggers stop(), which
+      // tears down this connection — the ack must already be in flight
+      uint8_t st = 0;
+      bool ok = write_full(fd, &st, 1);
+      {
+        std::lock_guard<std::mutex> lk(state_mu);
+        ++done_count;
+      }
+      cv.notify_all();
+      if (!ok) break;
+    } else if (op == OP_SHUTDOWN) {
+      {
+        std::lock_guard<std::mutex> lk(state_mu);
+        stopping = true;
+      }
+      cv.notify_all();
+      uint8_t st = 0;
+      write_full(fd, &st, 1);
+      // closing the listen socket unblocks accept()
+      shutdown(listen_fd, SHUT_RDWR);
+      break;
+    } else {
+      break;  // unknown opcode: drop the connection
+    }
+  }
+  // remove from the tracked set under the lock before closing, so stop()
+  // can never shutdown() an fd number the OS has already reused
+  {
+    std::lock_guard<std::mutex> lk(threads_mu);
+    for (auto& tracked : conn_fds)
+      if (tracked == fd) tracked = -1;
+  }
+  close(fd);
+}
+
+void PsServer::accept_loop() {
+  for (;;) {
+    int fd = accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      std::lock_guard<std::mutex> lk(state_mu);
+      if (stopping) return;
+      return;  // listen socket closed/broken
+    }
+    std::lock_guard<std::mutex> lk(threads_mu);
+    conn_fds.push_back(fd);
+    conn_threads.emplace_back(&PsServer::handle_conn, this, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts a server on 0.0.0.0:port (port 0 = ephemeral).  Returns an
+// opaque handle or nullptr on bind failure.
+void* dtf_ps_start(int port, float momentum) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(fd, 64) < 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  auto* s = new PsServer;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->momentum = momentum;
+  s->accept_thread = std::thread(&PsServer::accept_loop, s);
+  return s;
+}
+
+int dtf_ps_port(void* handle) {
+  return static_cast<PsServer*>(handle)->port;
+}
+
+// Blocks until `n_done` workers reported DONE or SHUTDOWN arrived.
+void dtf_ps_wait(void* handle, int n_done) {
+  auto* s = static_cast<PsServer*>(handle);
+  std::unique_lock<std::mutex> lk(s->state_mu);
+  s->cv.wait(lk, [&] { return s->stopping || s->done_count >= n_done; });
+}
+
+// Stops accepting, joins all threads, frees the handle.
+void dtf_ps_stop(void* handle) {
+  auto* s = static_cast<PsServer*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(s->state_mu);
+    s->stopping = true;
+  }
+  s->cv.notify_all();
+  shutdown(s->listen_fd, SHUT_RDWR);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // close only after the accept loop has exited: releasing the fd number
+  // while accept() may still run invites fd-reuse races
+  close(s->listen_fd);
+  std::vector<std::thread> threads;
+  {
+    std::lock_guard<std::mutex> lk(s->threads_mu);
+    for (int fd : s->conn_fds)
+      if (fd >= 0) shutdown(fd, SHUT_RDWR);
+    threads.swap(s->conn_threads);
+  }
+  // join outside the lock: an exiting conn thread needs threads_mu to
+  // untrack its fd
+  for (auto& t : threads)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+}  // extern "C"

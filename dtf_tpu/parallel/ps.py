@@ -1,0 +1,549 @@
+"""Asynchronous parameter-server training — the opt-in capability-exact
+mode (`--distribution_strategy parameter_server --ps_mode async`).
+
+The reference's PS path (SURVEY §3.4): rank 0 hosts variables in the TF
+grpc C++ runtime and serves push/pull forever; 15 workers each run an
+independent `model.fit` with `steps_per_epoch = train_steps // 15`,
+pulling params and pushing gradients per step with **no inter-worker
+synchronization** (per-worker epoch times diverge 652→1008 s, SURVEY
+§6).  XLA SPMD is synchronous by construction, so this mode keeps the
+async semantics *outside* the compiled step: a native C++ parameter
+store (`native/ps_store.cpp`) holds the flat parameter vector plus
+Keras-SGD momentum slots, and each worker process runs its own jitted
+forward/backward on its own chips, exchanging flat f32 buffers with the
+store over TCP.  The synchronous SPMD reinterpretation
+(`--ps_mode sync`, the default) remains the supported
+performance path (BASELINE.json north star).
+
+Rank mapping matches the reference deployment: process_id 0 is the PS
+(ps_server/resnet_imagenet_main_dist_ps_0.py is the PS rank), 1..N are
+workers 0..N-1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from dtf_tpu import native as native_lib
+
+log = logging.getLogger("dtf_tpu")
+
+OP_INIT, OP_PULL, OP_PUSH, OP_INFO, OP_DONE, OP_SHUTDOWN = 1, 2, 3, 4, 5, 6
+
+# Matches the C++ store's kMaxParams: a client-supplied count above this
+# is a corrupt/hostile request, not a real model (4B f32 = 16 GiB).
+MAX_PARAMS = 1 << 32
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+def _bind_native(lib: ctypes.CDLL) -> None:
+    if getattr(lib, "_ps_bound", False):
+        return
+    lib.dtf_ps_start.argtypes = [ctypes.c_int, ctypes.c_float]
+    lib.dtf_ps_start.restype = ctypes.c_void_p
+    lib.dtf_ps_port.argtypes = [ctypes.c_void_p]
+    lib.dtf_ps_port.restype = ctypes.c_int
+    lib.dtf_ps_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dtf_ps_stop.argtypes = [ctypes.c_void_p]
+    lib._ps_bound = True
+
+
+class PsServer:
+    """The native C++ parameter store (grpc-PS-runtime equivalent).
+    Falls back to a pure-Python threaded server when the .so is absent —
+    same wire protocol, so clients can't tell."""
+
+    def __init__(self, port: int = 0, momentum: float = 0.9):
+        lib = native_lib.load()
+        self._native = None
+        self._py: Optional[_PyPsServer] = None
+        if lib is not None and hasattr(lib, "dtf_ps_start"):
+            _bind_native(lib)
+            handle = lib.dtf_ps_start(port, momentum)
+            if not handle:
+                raise OSError(f"parameter store: cannot bind port {port}")
+            self._native = (lib, handle)
+            self.port = lib.dtf_ps_port(handle)
+        else:
+            self._py = _PyPsServer(port, momentum)
+            self.port = self._py.port
+        log.info("parameter store serving on port %d (%s)", self.port,
+                 "native" if self._native else "python")
+
+    def wait(self, n_done: int) -> None:
+        """Block until n_done workers reported DONE (or SHUTDOWN)."""
+        if self._native:
+            lib, handle = self._native
+            lib.dtf_ps_wait(handle, n_done)
+        else:
+            self._py.wait(n_done)
+
+    def stop(self) -> None:
+        if self._native:
+            lib, handle = self._native
+            lib.dtf_ps_stop(handle)
+            self._native = None
+        elif self._py:
+            self._py.stop()
+            self._py = None
+
+
+class _PyPsServer:
+    """Protocol-compatible fallback store (used when the C++ library is
+    not built; also documents the protocol in Python)."""
+
+    def __init__(self, port: int, momentum: float):
+        self.momentum = momentum
+        self.params: Optional[np.ndarray] = None
+        self.velocity: Optional[np.ndarray] = None
+        self.version = 0
+        self.mu = threading.Lock()
+        self.state = threading.Condition()
+        self.done_count = 0
+        self.stopping = False
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", port))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        self._threads = []
+        self._conns = []
+        self._conns_mu = threading.Lock()
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            with self._conns_mu:
+                self._conns.append(conn)
+                self._threads.append(t)
+            t.start()
+
+    def _serve(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                op = _recvn(conn, 1)
+                if not op:
+                    return
+                op = op[0]
+                if op == OP_INIT:
+                    (n,) = struct.unpack("<Q", _recvn(conn, 8))
+                    if n == 0 or n > MAX_PARAMS:
+                        return
+                    buf = np.frombuffer(_recvn(conn, 4 * n), np.float32)
+                    with self.mu:
+                        if self.params is None:
+                            self.params = buf.copy()
+                            self.velocity = np.zeros_like(self.params)
+                            st = 0
+                        else:
+                            st = 1
+                        conn.sendall(struct.pack("<BQQ", st, self.params.size,
+                                                 self.version))
+                elif op == OP_PULL:
+                    with self.mu:
+                        if self.params is None:
+                            conn.sendall(b"\x02")
+                            continue
+                        snap = self.params.tobytes()
+                        hdr = struct.pack("<BQQ", 0, self.params.size,
+                                          self.version)
+                    conn.sendall(hdr + snap)
+                elif op == OP_PUSH:
+                    lr, n = struct.unpack("<fQ", _recvn(conn, 12))
+                    if n == 0 or n > MAX_PARAMS:
+                        return
+                    g = np.frombuffer(_recvn(conn, 4 * n), np.float32)
+                    with self.mu:
+                        if self.params is None or self.params.size != n:
+                            conn.sendall(struct.pack("<BQ", 2, 0))
+                            continue
+                        self.velocity *= self.momentum
+                        self.velocity -= lr * g
+                        self.params += self.velocity
+                        self.version += 1
+                        conn.sendall(struct.pack("<BQ", 0, self.version))
+                elif op == OP_INFO:
+                    with self.mu:
+                        n = 0 if self.params is None else self.params.size
+                        st = 2 if self.params is None else 0
+                        conn.sendall(struct.pack("<BQQ", st, n, self.version))
+                elif op == OP_DONE:
+                    # ack before notifying: wait() returning triggers
+                    # stop(), which tears down this connection
+                    conn.sendall(b"\x00")
+                    with self.state:
+                        self.done_count += 1
+                        self.state.notify_all()
+                elif op == OP_SHUTDOWN:
+                    with self.state:
+                        self.stopping = True
+                        self.state.notify_all()
+                    conn.sendall(b"\x00")
+                    return
+                else:
+                    return
+        except (OSError, ValueError):
+            return
+        finally:
+            with self._conns_mu:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            conn.close()
+
+    def wait(self, n_done: int):
+        with self.state:
+            self.state.wait_for(
+                lambda: self.stopping or self.done_count >= n_done)
+
+    def stop(self):
+        """Mirror the native dtf_ps_stop: stop accepting, tear down live
+        connections, join serve threads — no push can land after stop."""
+        with self.state:
+            self.stopping = True
+            self.state.notify_all()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._accept.join(timeout=10)
+        with self._conns_mu:
+            conns = list(self._conns)
+            threads = list(self._threads)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=10)
+
+
+def _chain_first(first, it):
+    yield first
+    yield from it
+
+
+def _recvn(conn: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = conn.recv(n)
+        if not b:
+            raise ValueError("connection closed mid-message")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+class PsClient:
+    """Worker-side connection to the parameter store."""
+
+    def __init__(self, address: str, connect_timeout: float = 60.0):
+        host, _, port = address.rpartition(":")
+        self.address = (host or "127.0.0.1", int(port))
+        deadline = time.time() + connect_timeout
+        while True:
+            try:
+                self.sock = socket.create_connection(self.address, timeout=300)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)  # PS rank may still be starting
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def init(self, params: np.ndarray) -> Tuple[int, int]:
+        """Propose initial params; first worker wins (the
+        BroadcastGlobalVariablesCallback(0) equivalent).  Returns
+        (status, version)."""
+        params = np.ascontiguousarray(params, np.float32)
+        self.sock.sendall(bytes([OP_INIT]) +
+                          struct.pack("<Q", params.size) + params.tobytes())
+        st, n, ver = struct.unpack("<BQQ", _recvn(self.sock, 17))
+        if st not in (0, 1) or n != params.size:
+            raise ValueError(f"ps init rejected: status={st} size={n}")
+        return st, ver
+
+    def pull(self, retry_interval: float = 0.1,
+             timeout: float = 120.0) -> Tuple[int, np.ndarray]:
+        """Returns (version, flat params); blocks until initialized."""
+        deadline = time.time() + timeout
+        while True:
+            self.sock.sendall(bytes([OP_PULL]))
+            (st,) = _recvn(self.sock, 1)
+            if st == 0:
+                n, ver = struct.unpack("<QQ", _recvn(self.sock, 16))
+                flat = np.frombuffer(_recvn(self.sock, 4 * n), np.float32)
+                return ver, flat
+            if time.time() > deadline:
+                raise TimeoutError("parameter store never initialized")
+            time.sleep(retry_interval)
+
+    def push(self, lr: float, grads: np.ndarray) -> int:
+        """Apply one async Keras-SGD step on the store; returns the new
+        version."""
+        grads = np.ascontiguousarray(grads, np.float32)
+        self.sock.sendall(bytes([OP_PUSH]) +
+                          struct.pack("<fQ", float(lr), grads.size) +
+                          grads.tobytes())
+        st, ver = struct.unpack("<BQ", _recvn(self.sock, 9))
+        if st != 0:
+            raise ValueError(f"ps push rejected: status={st}")
+        return ver
+
+    def info(self) -> Tuple[int, int, int]:
+        self.sock.sendall(bytes([OP_INFO]))
+        st, n, ver = struct.unpack("<BQQ", _recvn(self.sock, 17))
+        return st, n, ver
+
+    def done(self) -> None:
+        self.sock.sendall(bytes([OP_DONE]))
+        try:
+            _recvn(self.sock, 1)
+        except (ValueError, OSError):
+            # the store may tear down as soon as the last DONE lands;
+            # losing the ack is fine — the DONE itself was delivered
+            pass
+
+    def shutdown_server(self) -> None:
+        self.sock.sendall(bytes([OP_SHUTDOWN]))
+        _recvn(self.sock, 1)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# The async training entry (role dispatch)
+# ---------------------------------------------------------------------------
+
+def run_async(cfg) -> dict:
+    """Async-PS run: process 0 serves, 1..N train independently.
+
+    With no multi-process topology configured, runs a self-contained
+    single-process demo: in-process store + one worker loop (the
+    easiest way to see the async mode work, and what the tests drive).
+    """
+    n_procs = cfg.process_count or 1
+    if n_procs <= 1:
+        server = PsServer(port=0)
+        try:
+            return _worker(cfg, f"127.0.0.1:{server.port}", worker_id=0,
+                           num_workers=1)
+        finally:
+            server.stop()
+
+    if not cfg.coordinator_address or cfg.process_id is None:
+        raise ValueError("async parameter_server needs coordinator_address "
+                         "and process_id (the PS address doubles as the "
+                         "coordinator)")
+    num_workers = n_procs - 1
+    if cfg.process_id == 0:
+        port = int(cfg.coordinator_address.rpartition(":")[2])
+        server = PsServer(port=port)
+        log.info("PS rank: serving %d workers", num_workers)
+        try:
+            server.wait(num_workers)  # blocks like the reference PS rank,
+        finally:                      # but exits when all workers finish
+            server.stop()
+        return {}
+    return _worker(cfg, cfg.coordinator_address,
+                   worker_id=cfg.process_id - 1, num_workers=num_workers)
+
+
+def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from dtf_tpu.data import get_dataset_spec, synthetic_input_fn
+    from dtf_tpu.models import build_model
+    from dtf_tpu.models.registry import l2_weight_penalty
+    from dtf_tpu.train import schedules as sched_lib
+    from dtf_tpu.train.loop import cross_entropy
+    from dtf_tpu.utils.logs import TimeHistory, build_stats
+
+    spec = get_dataset_spec(cfg.dataset)
+    if cfg.num_classes:
+        import dataclasses
+        spec = dataclasses.replace(spec, num_classes=cfg.num_classes)
+
+    if cfg.stop_threshold is not None and worker_id == 0:
+        log.warning("--stop_threshold is ignored in async PS mode: workers "
+                    "evaluate once after their step budget, not per epoch")
+
+    batch = cfg.batch_size  # per-worker, like the reference's --batch_size 192
+    model_name = "trivial" if cfg.use_trivial_model else cfg.model
+    model, l2w = build_model(model_name, num_classes=spec.num_classes,
+                             dtype=cfg.compute_dtype)
+
+    # steps_per_epoch = train_steps // num_workers (ps_0.py:263 semantics)
+    full_steps = max(spec.num_train // batch, 1)
+    steps_per_epoch = max(full_steps // num_workers, 1)
+    train_epochs = cfg.train_epochs
+    if cfg.train_steps:
+        steps_per_epoch = min(cfg.train_steps, steps_per_epoch)
+        train_epochs = 1
+    # The reference's LR callback follows the *keras epoch counter*
+    # (common.py LearningRateBatchScheduler uses on_epoch_begin's epoch),
+    # and each PS worker's epoch is steps//num_workers long — so the
+    # schedule must be built on the per-worker epoch length for decay
+    # boundaries to land on the same epoch numbers.
+    schedule = sched_lib.for_dataset(spec.name, batch, steps_per_epoch,
+                                     spec.num_train,
+                                     use_tensor_lr=cfg.use_tensor_lr)
+
+    if cfg.use_synthetic_data or not cfg.data_dir:
+        train_iter = synthetic_input_fn(spec, True, batch,
+                                        cfg.seed + worker_id)
+        eval_iter_fn = lambda: synthetic_input_fn(spec, False, batch,
+                                                  cfg.seed + 10_000)
+    elif spec.name == "cifar10":
+        from dtf_tpu.data.cifar import cifar_input_fn
+        train_iter = cifar_input_fn(cfg.data_dir, True, batch, seed=cfg.seed,
+                                    process_id=worker_id,
+                                    process_count=num_workers)
+        eval_iter_fn = lambda: cifar_input_fn(cfg.data_dir, False, batch)
+    else:
+        from dtf_tpu.data.imagenet import imagenet_input_fn
+        train_iter = imagenet_input_fn(cfg.data_dir, True, batch,
+                                       seed=cfg.seed, process_id=worker_id,
+                                       process_count=num_workers)
+        eval_iter_fn = lambda: imagenet_input_fn(cfg.data_dir, False, batch)
+
+    first_batch = next(train_iter)
+    train_iter = _chain_first(first_batch, train_iter)  # don't drop batch 0
+    variables = jax.jit(model.init, static_argnames=("train",))(
+        jax.random.key(cfg.seed), jnp.asarray(first_batch[0][:1]), train=False)
+    params0 = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    flat0, unravel = ravel_pytree(params0)
+
+    client = PsClient(ps_address)
+    st, _ = client.init(np.asarray(jax.device_get(flat0), np.float32))
+    log.info("worker %d/%d: params %d floats (%s init)", worker_id,
+             num_workers, flat0.size, "won" if st == 0 else "lost")
+
+    has_bn = bool(batch_stats)
+
+    @jax.jit
+    def step_fn(flat_params, batch_stats, images, labels):
+        params = unravel(flat_params)
+
+        def loss_fn(p):
+            variables = {"params": p}
+            if has_bn:
+                variables["batch_stats"] = batch_stats
+                logits, mut = model.apply(variables, images, train=True,
+                                          mutable=["batch_stats"])
+                new_stats = mut["batch_stats"]
+            else:
+                logits = model.apply(variables, images, train=True)
+                new_stats = batch_stats
+            loss = cross_entropy(logits, labels) + l2_weight_penalty(p, l2w)
+            return loss, (logits, new_stats)
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        gflat, _ = ravel_pytree(grads)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return gflat.astype(jnp.float32), loss, acc, new_stats
+
+    @jax.jit
+    def eval_fn(flat_params, batch_stats, images, labels):
+        params = unravel(flat_params)
+        variables = {"params": params}
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+        logits = model.apply(variables, images, train=False)
+        loss = cross_entropy(logits, labels)
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, acc
+
+    time_cb = TimeHistory(batch, cfg.log_steps)
+    acc_key = ("categorical_accuracy" if spec.one_hot
+               else "sparse_categorical_accuracy")
+    history: dict = {"loss": [], acc_key: []}
+    time_cb.on_train_begin()
+    local_step = 0
+    for epoch in range(train_epochs):
+        time_cb.on_epoch_begin(epoch)
+        for _ in range(steps_per_epoch):
+            time_cb.on_batch_begin(local_step)
+            version, flat = client.pull()
+            images, labels = next(train_iter)
+            gflat, loss, acc, batch_stats = step_fn(
+                jnp.asarray(flat), batch_stats, jnp.asarray(images),
+                jnp.asarray(labels))
+            # ASYNC NETWORK BOUNDARY: push to the store; other workers
+            # may have advanced `version` meanwhile (stale gradients are
+            # inherent to async PS — same as the reference)
+            lr = float(schedule(jnp.asarray(local_step)))
+            client.push(lr, np.asarray(jax.device_get(gflat)))
+            local_step += 1
+            time_cb.on_batch_end(local_step)
+        m_loss, m_acc = float(jax.device_get(loss)), float(jax.device_get(acc))
+        history["loss"].append(m_loss)
+        history[acc_key].append(m_acc)
+        time_cb.on_epoch_end(epoch)
+        log.info("worker %d epoch %d/%d: loss=%.4f top1=%.4f", worker_id,
+                 epoch + 1, train_epochs, m_loss, m_acc)
+    time_cb.on_train_end()
+
+    eval_output = None
+    if not cfg.skip_eval and worker_id == 0:
+        _, flat = client.pull()
+        losses, accs = [], []
+        for images, labels in eval_iter_fn():
+            l, a = eval_fn(jnp.asarray(flat), batch_stats,
+                           jnp.asarray(images), jnp.asarray(labels))
+            losses.append(float(l))
+            accs.append(float(a))
+        if losses:
+            eval_output = (float(np.mean(losses)), float(np.mean(accs)))
+            log.info("worker 0 eval: loss=%.4f top1=%.4f", *eval_output)
+
+    stats = build_stats(history, eval_output, time_cb)
+    if worker_id == 0:
+        if cfg.export_dir:
+            # --export_dir: final store params + this worker's BN stats
+            import types
+            from dtf_tpu.train.checkpoint import export_model
+            _, flat = client.pull()
+            export_model(cfg.export_dir, types.SimpleNamespace(
+                params=unravel(jnp.asarray(flat)), batch_stats=batch_stats))
+        if cfg.benchmark_log_dir:
+            from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
+            blog = BenchmarkFileLogger(cfg.benchmark_log_dir)
+            blog.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
+                              test_id=cfg.benchmark_test_id)
+            blog.log_stats(stats, global_step=local_step)
+    client.done()
+    client.close()
+    log.info("Run stats: %s",
+             {k: v for k, v in stats.items() if k != "step_timestamp_log"})
+    return stats

@@ -1,0 +1,258 @@
+"""Async parameter-server mode tests.
+
+The reference's PS path had zero automated coverage (SURVEY §4: its
+correctness evidence is 16 hand-run cluster logs).  Here the protocol,
+the store's Keras-SGD update, multi-client concurrency, and the full
+async training path are all exercised in CI — against the native C++
+store when built, and the protocol-compatible Python fallback either
+way.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dtf_tpu import native as native_lib
+from dtf_tpu.parallel import ps as ps_lib
+
+
+def has_native():
+    lib = native_lib.load()
+    return lib is not None and hasattr(lib, "dtf_ps_start")
+
+
+@pytest.fixture(params=["native", "python"])
+def server(request, monkeypatch):
+    if request.param == "native" and not has_native():
+        pytest.skip("native ps store not built")
+    if request.param == "python":
+        # force the fallback path through the public PsServer API
+        monkeypatch.setattr(native_lib, "_lib", None)
+        monkeypatch.setattr(native_lib, "load", lambda: None)
+    srv = ps_lib.PsServer(port=0)
+    yield srv
+    srv.stop()
+
+
+def test_init_pull_push_roundtrip(server):
+    client = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    p0 = np.arange(5, dtype=np.float32)
+    st, ver = client.init(p0)
+    assert st == 0 and ver == 0
+    # second init loses
+    st2, _ = client.init(np.zeros(5, np.float32))
+    assert st2 == 1
+    ver, flat = client.pull()
+    np.testing.assert_array_equal(flat, p0)
+
+    # keras SGD: v = m*v - lr*g; p += v  (momentum 0.9)
+    g = np.ones(5, np.float32)
+    ver = client.push(0.1, g)
+    assert ver == 1
+    _, flat1 = client.pull()
+    np.testing.assert_allclose(flat1, p0 - 0.1, rtol=1e-6)
+    ver = client.push(0.1, g)
+    assert ver == 2
+    _, flat2 = client.pull()
+    # v1 = -0.1; v2 = 0.9*(-0.1) - 0.1 = -0.19
+    np.testing.assert_allclose(flat2, p0 - 0.1 - 0.19, rtol=1e-6)
+    client.done()
+    client.close()
+
+
+def test_pull_before_init_blocks_then_succeeds(server):
+    out = {}
+
+    def puller():
+        c = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+        out["flat"] = c.pull(timeout=30)[1]
+        c.close()
+
+    t = threading.Thread(target=puller)
+    t.start()
+    c2 = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    c2.init(np.full(3, 7.0, np.float32))
+    t.join(timeout=30)
+    assert not t.is_alive()
+    np.testing.assert_array_equal(out["flat"], np.full(3, 7.0, np.float32))
+    c2.close()
+
+
+def test_concurrent_pushes_all_applied(server):
+    """Hogwild-style concurrency: N threads × K pushes each all land
+    (version counts them) and the result equals the serial equivalent
+    for momentum=0 ordering-independent sums... momentum makes order
+    matter, so use lr pushes of zeros + one sentinel check on version."""
+    c0 = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    c0.init(np.zeros(4, np.float32))
+    N, K = 4, 25
+
+    def worker():
+        c = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+        for _ in range(K):
+            c.push(0.01, np.ones(4, np.float32))
+        c.done()
+        c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    st, n, ver = c0.info()
+    assert ver == N * K
+    server.wait(N)  # all DONEs arrived
+    c0.close()
+
+
+def test_wait_unblocks_on_done(server):
+    c = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    c.init(np.zeros(2, np.float32))
+    done = threading.Event()
+
+    def waiter():
+        server.wait(1)
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert not done.wait(0.2)
+    c.done()
+    assert done.wait(30)
+    t.join()
+    c.close()
+
+
+def test_run_async_single_process_demo():
+    """The self-contained async mode: in-process store + 1 worker."""
+    import dataclasses
+    import dtf_tpu.data.base as data_base
+    from dtf_tpu.cli import run
+    from dtf_tpu.config import Config
+
+    tiny = dataclasses.replace(data_base.CIFAR10, image_size=8,
+                               num_train=64, num_eval=16)
+    orig = data_base._SPECS["cifar10"]
+    data_base._SPECS["cifar10"] = tiny
+    try:
+        cfg = Config(model="resnet20", dataset="cifar10", batch_size=8,
+                     train_steps=2, use_synthetic_data=True,
+                     distribution_strategy="parameter_server",
+                     ps_mode="async", skip_eval=False, skip_checkpoint=True,
+                     model_dir="", log_steps=1)
+        stats = run(cfg)
+    finally:
+        data_base._SPECS["cifar10"] = orig
+    assert np.isfinite(stats["loss"])
+    assert "accuracy_top_1" in stats
+
+
+def test_async_training_converges():
+    """2 worker threads against one store drive a least-squares model's
+    loss down — async staleness and all."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    true_w = rng.normal(size=(8,)).astype(np.float32)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    y = X @ true_w
+
+    server = ps_lib.PsServer(port=0)
+    try:
+        @jax.jit
+        def grad_fn(w, xb, yb):
+            loss = jnp.mean((xb @ w - yb) ** 2)
+            return jax.grad(lambda w: jnp.mean((xb @ w - yb) ** 2))(w), loss
+
+        c0 = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+        c0.init(np.zeros(8, np.float32))
+
+        def worker(seed):
+            c = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+            r = np.random.default_rng(seed)
+            for _ in range(150):
+                _, w = c.pull()
+                idx = r.integers(0, 64, size=16)
+                g, _ = grad_fn(jnp.asarray(w), X[idx], y[idx])
+                c.push(0.02, np.asarray(g))
+            c.done()
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(s,)) for s in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        server.wait(2)
+        _, w_final = c0.pull()
+        final_loss = float(np.mean((X @ w_final - y) ** 2))
+        assert final_loss < 1e-2, f"async training failed to converge: {final_loss}"
+        c0.close()
+    finally:
+        server.stop()
+
+
+PS_WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import logging; logging.basicConfig(level=logging.INFO)
+import dataclasses
+import dtf_tpu.data.base as data_base
+data_base._SPECS["cifar10"] = dataclasses.replace(
+    data_base.CIFAR10, image_size=8, num_train=64, num_eval=16)
+from dtf_tpu.cli import run
+from dtf_tpu.config import Config
+from dtf_tpu.config.flags import apply_env_topology
+cfg = Config(model="resnet20", dataset="cifar10", batch_size=8,
+             train_steps=2, use_synthetic_data=True, skip_eval=True,
+             skip_checkpoint=True, model_dir="", log_steps=1,
+             distribution_strategy="parameter_server", ps_mode="async")
+cfg = apply_env_topology(cfg)
+stats = run(cfg)
+if stats:
+    print("FINAL_LOSS=%.6f" % stats["loss"])
+else:
+    print("PS_RANK_DONE")
+"""
+
+
+@pytest.mark.slow
+def test_three_process_async_ps(tmp_path):
+    """1 PS + 2 workers as real OS processes — the reference's 16-rank
+    deployment shape (SURVEY §3.4), fully automated."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "ps_worker.py"
+    script.write_text(PS_WORKER)
+    env = dict(os.environ, PYTHONPATH=repo)
+    rc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.cli.launch",
+         "--num_processes", "3", "--coordinator", "localhost:12477",
+         "--log_dir", str(tmp_path / "logs"), "--",
+         sys.executable, str(script)],
+        cwd=repo, timeout=600, capture_output=True, text=True, env=env)
+
+    def tail(i):
+        p = tmp_path / "logs" / f"log{i}.log"
+        return p.read_text()[-2000:] if p.exists() else "<no log>"
+
+    assert rc.returncode == 0, (
+        f"launcher failed: {rc.stderr[-1000:]}\n{tail(0)}\n{tail(1)}\n{tail(2)}")
+    ps_log = (tmp_path / "logs" / "log0.log").read_text()
+    assert "PS_RANK_DONE" in ps_log
+    losses = []
+    for i in (1, 2):
+        text = (tmp_path / "logs" / f"log{i}.log").read_text()
+        m = re.search(r"FINAL_LOSS=([\d.]+)", text)
+        assert m, f"no final loss in worker {i} log:\n{text[-2000:]}"
+        losses.append(float(m.group(1)))
+    assert all(np.isfinite(l) for l in losses)
