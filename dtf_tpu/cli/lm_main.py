@@ -1,0 +1,45 @@
+"""Language-model entry point — the long-context workload.
+
+No reference counterpart (the reference is vision-only, SURVEY §5.7);
+this main exposes the framework's long-context machinery end-to-end:
+flash-attention on-chip, ring attention across the 'seq' mesh axis.
+
+Examples:
+  # single chip, flash attention:
+  python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
+      --batch_size 4 --model transformer_small
+
+  # 8-device mesh, 2-way data x 4-way sequence parallel ring attention:
+  python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
+      --batch_size 4 --seq_parallelism 4 --dtype bf16
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from dtf_tpu.cli.runner import run
+from dtf_tpu.config import parse_flags
+
+LM_DEFAULTS = dict(
+    model="transformer",
+    dataset="lm",
+    train_epochs=1,
+    batch_size=8,
+    dtype="bf16",
+    skip_eval=True,
+)
+
+
+def main(argv=None) -> dict:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    cfg = parse_flags(argv if argv is not None else sys.argv[1:],
+                      defaults=LM_DEFAULTS)
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    main()

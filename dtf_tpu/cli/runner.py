@@ -19,7 +19,7 @@ from dtf_tpu.data import DatasetSpec, get_dataset_spec, synthetic_input_fn
 from dtf_tpu.data.pipeline import DevicePrefetcher
 from dtf_tpu.models import build_model
 from dtf_tpu.runtime import initialize, is_coordinator
-from dtf_tpu.runtime.mesh import DATA_AXIS
+from dtf_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS
 from dtf_tpu.train import Trainer
 
 log = logging.getLogger("dtf_tpu")
@@ -100,10 +100,13 @@ def run(cfg: Config) -> dict:
     global_batch = effective_global_batch(cfg, rt)
     cfg = cfg.replace(batch_size=global_batch)
 
+    rt.shard_seq = spec.is_sequence
     model_name = "trivial" if cfg.use_trivial_model else cfg.model
+    seq_axis = (SEQ_AXIS if spec.is_sequence and cfg.seq_parallelism > 1
+                else None)
     model, l2 = build_model(
         model_name, num_classes=spec.num_classes, dtype=cfg.compute_dtype,
-        bn_axis=DATA_AXIS if cfg.sync_bn else None)
+        bn_axis=DATA_AXIS if cfg.sync_bn else None, seq_axis=seq_axis)
 
     trainer = Trainer(cfg, rt, model, l2, spec)
     train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)

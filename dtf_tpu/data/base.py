@@ -22,15 +22,21 @@ class DatasetSpec:
     name: str
     image_size: int
     num_channels: int
-    num_classes: int
+    num_classes: int       # for token datasets: the vocabulary size
     num_train: int
     num_eval: int
     one_hot: bool          # cifar uses one-hot + categorical CE; imagenet sparse
     mean_subtract: bool = False
+    seq_len: int = 0       # >0 ⇒ token-sequence dataset ([B, S] int32 inputs,
+                           # next-token labels); enables the 'seq' mesh axis
 
     @property
     def image_shape(self):
         return (self.image_size, self.image_size, self.num_channels)
+
+    @property
+    def is_sequence(self) -> bool:
+        return self.seq_len > 0
 
 
 # Cardinalities from the reference:
@@ -42,8 +48,14 @@ class DatasetSpec:
 CIFAR10 = DatasetSpec("cifar10", 32, 3, 10, 50_000, 10_000, one_hot=True)
 IMAGENET = DatasetSpec("imagenet", 224, 3, 1001, 1_281_167, 50_000,
                        one_hot=False, mean_subtract=True)
+# Language-modeling workload (no reference equivalent — the reference is
+# vision-only, SURVEY §5.7 — but long-context is first-class here):
+# next-token prediction over [B, seq_len] int32 token ids.
+LM = DatasetSpec("lm", 0, 0, num_classes=32_768, num_train=100_000,
+                 num_eval=1_000, one_hot=False, seq_len=2048)
 
-_SPECS = {"cifar10": CIFAR10, "cifar": CIFAR10, "imagenet": IMAGENET}
+_SPECS = {"cifar10": CIFAR10, "cifar": CIFAR10, "imagenet": IMAGENET,
+          "lm": LM}
 
 
 def get_dataset_spec(name: str) -> DatasetSpec:

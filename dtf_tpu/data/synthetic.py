@@ -31,10 +31,18 @@ def synthetic_input_fn(spec: DatasetSpec, is_training: bool, batch_size: int,
     eval pass.  labels are int32 class ids; one-hot is applied by the
     loss layer when spec.one_hot."""
     rng = np.random.default_rng(seed)
-    images = _truncated_normal(
-        rng, (batch_size,) + spec.image_shape, 127.0, 60.0).astype(dtype)
-    labels = rng.integers(0, spec.num_classes - 1, size=(batch_size,),
-                          dtype=np.int32)
+    if spec.is_sequence:
+        # token LM: random ids, next-token labels (shift left; the final
+        # position wraps — harmless for synthetic throughput/smoke data)
+        tokens = rng.integers(0, spec.num_classes,
+                              size=(batch_size, spec.seq_len), dtype=np.int32)
+        images = tokens
+        labels = np.roll(tokens, -1, axis=1)
+    else:
+        images = _truncated_normal(
+            rng, (batch_size,) + spec.image_shape, 127.0, 60.0).astype(dtype)
+        labels = rng.integers(0, spec.num_classes - 1, size=(batch_size,),
+                              dtype=np.int32)
 
     def gen():
         if is_training:

@@ -16,7 +16,9 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from dtf_tpu.models import resnet, resnet_cifar, trivial
+import functools
+
+from dtf_tpu.models import resnet, resnet_cifar, transformer, trivial
 
 # reference weight-decay constants
 L2_IMAGENET = 1e-4  # resnet_model.py:37
@@ -30,20 +32,36 @@ _REGISTRY = {
     "resnet110": (resnet_cifar.resnet110, 10, L2_CIFAR),
     "resnet662": (resnet_cifar.resnet662, 10, L2_CIFAR),
     "trivial": (trivial.TrivialModel, 1001, 0.0),
+    # LM family (no L2: the reference's weight-decay rule is ResNet-only)
+    "transformer": (transformer.TransformerLM, 32_768, 0.0),
+    "transformer_small": (
+        functools.partial(transformer.TransformerLM, num_layers=4,
+                          d_model=256, num_heads=4, d_ff=1024),
+        32_768, 0.0),
 }
 
 
 def build_model(name: str, num_classes: int | None = None,
-                dtype: Any = jnp.float32, bn_axis: str | None = None):
-    """Returns (module, l2_weight).  `bn_axis` names the mesh axis for
-    cross-replica (sync) BatchNorm; None = per-replica statistics, the
-    reference's implicit MirroredStrategy behavior (SURVEY §7.4)."""
+                dtype: Any = jnp.float32, bn_axis: str | None = None,
+                seq_axis: str | None = None, **model_kw):
+    """Returns (module, l2_weight).
+
+    `bn_axis` names the mesh axis for cross-replica (sync) BatchNorm;
+    None = per-replica statistics, the reference's implicit
+    MirroredStrategy behavior (SURVEY §7.4).  `seq_axis` names the mesh
+    axis the sequence dimension is sharded over (transformer family
+    only) — it switches attention to the ring implementation."""
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
     ctor, default_classes, l2 = _REGISTRY[name]
-    kw = dict(num_classes=num_classes or default_classes, dtype=dtype)
-    if name != "trivial":
-        kw["bn_axis"] = bn_axis
+    if name.startswith("transformer"):
+        kw = dict(vocab_size=num_classes or default_classes, dtype=dtype,
+                  seq_axis=seq_axis, **model_kw)
+    else:
+        kw = dict(num_classes=num_classes or default_classes, dtype=dtype,
+                  **model_kw)
+        if name != "trivial":
+            kw["bn_axis"] = bn_axis
     module = ctor(**kw)
     return module, l2
 

@@ -1,0 +1,123 @@
+"""Decoder-only transformer LM — the long-context workload.
+
+No reference equivalent (the reference is vision-only, SURVEY.md §5.7);
+this is the model family that exercises the framework's first-class
+long-context machinery: the Pallas flash-attention kernel
+(`ops.flash_attention`) on a single chip, and ring attention over the
+'seq' mesh axis (`parallel.ring_attention`) when the sequence dimension
+is sharded (`--seq_parallelism N`).
+
+Design (TPU-first):
+  - pre-LN blocks, GELU MLP — everything fuses into the two MXU matmuls
+    per sublayer under XLA.
+  - causal attention via the flash kernel: O(S·D) HBM traffic instead
+    of an [S, S] score matrix.
+  - `seq_axis` set ⇒ the module is running *inside* `shard_map` with
+    its sequence dimension sharded: attention switches to the K/V ring
+    (ICI neighbor exchange overlapped with compute) and position
+    embeddings are offset by the shard's global position.
+  - optional `remat` wraps each block in `jax.checkpoint`, trading
+    FLOPs for HBM (the standard long-context memory lever).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dtf_tpu.ops.flash_attention import flash_attention
+from dtf_tpu.parallel.ring_attention import ring_attention
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None   # set when seq dim is mesh-sharded
+    use_pallas: Any = None           # None=auto; False forces blockwise-JAX
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        head_dim = d // self.num_heads
+        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), dtype=self.dtype,
+                              name="qkv")(x)
+        q, k, v = (qkv[..., i, :, :] for i in range(3))  # [B, S, H, Dh]
+        if self.seq_axis is not None:
+            # sequence-parallel: K/V rotate around the 'seq' ring; every
+            # query still attends to the full global sequence
+            o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        else:
+            o = flash_attention(q, k, v, causal=True,
+                                use_pallas=self.use_pallas)
+        o = o.reshape(b, s, d)
+        return nn.Dense(d, dtype=self.dtype, name="out")(o)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_ff: int
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    use_pallas: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, dtype=self.dtype, seq_axis=self.seq_axis,
+            use_pallas=self.use_pallas, name="attn")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Next-token LM.  __call__(tokens [B, S] int32, train) -> logits
+    [B, S, vocab] (f32 — softmax precision, like the ResNets' fp32
+    softmax cast, reference resnet_model.py:385-388)."""
+
+    vocab_size: int
+    num_layers: int = 12
+    d_model: int = 512
+    num_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    use_pallas: Any = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        del train  # no dropout/BN: LN only, same train/eval behavior
+        b, s_local = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="embed")(tokens)
+        # learned positions; under seq sharding each shard takes its
+        # global slice of the table
+        pos_table = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_seq_len, self.d_model))
+        offset = 0
+        if self.seq_axis is not None:
+            offset = jax.lax.axis_index(self.seq_axis) * s_local
+        pos = jax.lax.dynamic_slice_in_dim(pos_table, offset, s_local)
+        x = x + pos.astype(self.dtype)
+
+        block = Block
+        if self.remat:
+            block = nn.remat(Block)
+        for i in range(self.num_layers):
+            x = block(self.num_heads, self.d_ff, dtype=self.dtype,
+                      seq_axis=self.seq_axis, use_pallas=self.use_pallas,
+                      name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

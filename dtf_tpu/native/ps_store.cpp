@@ -32,6 +32,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -58,7 +59,8 @@ bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
   while (n) {
     ssize_t got = recv(fd, p, n, 0);
-    if (got <= 0) return false;
+    if (got < 0 && errno == EINTR) continue;  // CPython installs signal
+    if (got <= 0) return false;               // handlers without SA_RESTART
     p += got;
     n -= static_cast<size_t>(got);
   }
@@ -69,6 +71,7 @@ bool write_full(int fd, const void* buf, size_t n) {
   auto* p = static_cast<const uint8_t*>(buf);
   while (n) {
     ssize_t put = send(fd, p, n, MSG_NOSIGNAL);
+    if (put < 0 && errno == EINTR) continue;
     if (put <= 0) return false;
     p += put;
     n -= static_cast<size_t>(put);
@@ -225,8 +228,9 @@ void PsServer::handle_conn(int fd) {
       cv.notify_all();
       uint8_t st = 0;
       write_full(fd, &st, 1);
-      // closing the listen socket unblocks accept()
-      shutdown(listen_fd, SHUT_RDWR);
+      // unblocking accept() is dtf_ps_stop's job — touching listen_fd
+      // from this thread races with stop() having already close()d it
+      // (fd-number reuse)
       break;
     } else {
       break;  // unknown opcode: drop the connection

@@ -23,6 +23,7 @@ workers 0..N-1.
 from __future__ import annotations
 
 import ctypes
+import itertools
 import logging
 import socket
 import struct
@@ -219,6 +220,12 @@ class _PyPsServer:
         with self.state:
             self.stopping = True
             self.state.notify_all()
+        # shutdown() before close(): on Linux a thread blocked in
+        # accept() is NOT woken by close() alone
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -234,11 +241,6 @@ class _PyPsServer:
                 pass
         for t in threads:
             t.join(timeout=10)
-
-
-def _chain_first(first, it):
-    yield first
-    yield from it
 
 
 def _recvn(conn: socket.socket, n: int) -> bytes:
@@ -436,7 +438,7 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
         eval_iter_fn = lambda: imagenet_input_fn(cfg.data_dir, False, batch)
 
     first_batch = next(train_iter)
-    train_iter = _chain_first(first_batch, train_iter)  # don't drop batch 0
+    train_iter = itertools.chain([first_batch], train_iter)  # keep batch 0
     variables = jax.jit(model.init, static_argnames=("train",))(
         jax.random.key(cfg.seed), jnp.asarray(first_batch[0][:1]), train=False)
     params0 = variables["params"]

@@ -97,6 +97,10 @@ class MeshRuntime:
 
     mesh: Mesh
     strategy: str
+    # Token datasets shard dim 1 (sequence) over the 'seq' axis as well;
+    # set by the runner from DatasetSpec.is_sequence.  Harmless when the
+    # seq axis has size 1.
+    shard_seq: bool = False
 
     @property
     def num_replicas(self) -> int:
@@ -111,11 +115,14 @@ class MeshRuntime:
         return NamedSharding(self.mesh, P())
 
     def data_sharding(self, ndim: int = 1) -> NamedSharding:
-        """Batch dim sharded over 'data'; rest replicated."""
-        return NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+        """Batch dim sharded over 'data'; for sequence data dim 1 is
+        additionally sharded over 'seq'; rest replicated."""
+        return NamedSharding(self.mesh, self.batch_spec(ndim))
 
-    def batch_spec(self) -> P:
-        return P(DATA_AXIS)
+    def batch_spec(self, ndim: int = 1) -> P:
+        if self.shard_seq and ndim >= 2:
+            return P(DATA_AXIS, SEQ_AXIS, *([None] * (ndim - 2)))
+        return P(DATA_AXIS, *([None] * (ndim - 1)))
 
     def shard_batch(self, batch):
         """Place a host-global batch onto the mesh, sharded on dim 0.

@@ -38,7 +38,7 @@ from jax.sharding import PartitionSpec as P
 from dtf_tpu.config import Config
 from dtf_tpu.data.base import DatasetSpec
 from dtf_tpu.models.registry import l2_weight_penalty
-from dtf_tpu.runtime.mesh import DATA_AXIS, MeshRuntime
+from dtf_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS, MeshRuntime
 from dtf_tpu.train import schedules as sched_lib
 from dtf_tpu.train.optimizer import keras_sgd
 from dtf_tpu.utils.logs import TimeHistory, build_stats
@@ -84,6 +84,12 @@ class Trainer:
                 f"the number of data-parallel replicas "
                 f"({runtime.num_replicas}); pick a batch size that is a "
                 f"multiple, or reduce --num_devices")
+        if spec.is_sequence:
+            sp = runtime.mesh.shape[SEQ_AXIS]
+            if spec.seq_len % sp:
+                raise ValueError(
+                    f"seq_len {spec.seq_len} must be divisible by "
+                    f"seq_parallelism ({sp})")
         self.steps_per_epoch = spec.num_train // self.global_batch
         if self.steps_per_epoch == 0:
             raise ValueError(
@@ -119,7 +125,13 @@ class Trainer:
         every process initializes from the same seed, so params are
         identical without a broadcast."""
         images = jnp.asarray(sample_batch[0][:1])
-        variables = jax.jit(self.model.init, static_argnames=("train",))(
+        # a seq-sharded module calls lax.axis_index and can only run
+        # inside shard_map; param shapes don't depend on seq_axis, so
+        # init with an unsharded twin
+        init_model = self.model
+        if getattr(init_model, "seq_axis", None) is not None:
+            init_model = init_model.clone(seq_axis=None)
+        variables = jax.jit(init_model.init, static_argnames=("train",))(
             rng, images, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
@@ -144,7 +156,14 @@ class Trainer:
 
     def _build_steps(self):
         mesh = self.rt.mesh
-        data_spec = P(DATA_AXIS)
+        # token data shards [B, S] over (data, seq); vision shards dim 0
+        if self.spec.is_sequence:
+            data_spec = P(DATA_AXIS, SEQ_AXIS)
+        else:
+            data_spec = P(DATA_AXIS)
+        # gradients/metrics average over every axis the batch is split
+        # across; 'seq' has size 1 (identity) for vision runs
+        reduce_axes = (DATA_AXIS, SEQ_AXIS)
         rep = P()
         loss_scale = self.loss_scale
         l2w = self.l2_weight
@@ -162,20 +181,22 @@ class Trainer:
             if loss_scale != 1.0:
                 grads = jax.tree_util.tree_map(
                     lambda g: g / loss_scale, grads)
-            # DEVICE/NETWORK BOUNDARY: gradient all-reduce over 'data'
-            # (≡ NCCL ring / collective allreduce / PS push-pull, SURVEY §3)
-            grads = jax.lax.pmean(grads, DATA_AXIS)
+            # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
+            # batch-splitting axes (≡ NCCL ring / collective allreduce /
+            # PS push-pull, SURVEY §3); includes 'seq' when the sequence
+            # dimension is sharded (each shard's loss covers 1/sp tokens)
+            grads = jax.lax.pmean(grads, reduce_axes)
             # per-replica BN stats averaged on update — MirroredStrategy's
             # variable aggregation semantics
-            new_stats = jax.lax.pmean(new_stats, DATA_AXIS)
+            new_stats = jax.lax.pmean(new_stats, reduce_axes)
 
             updates, new_opt = self.tx.update(
                 grads, state.opt_state, state.params, step=state.step)
             params = optax.apply_updates(state.params, updates)
             acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
             metrics = {
-                "loss": jax.lax.pmean(loss, DATA_AXIS),
-                "accuracy": jax.lax.pmean(acc, DATA_AXIS),
+                "loss": jax.lax.pmean(loss, reduce_axes),
+                "accuracy": jax.lax.pmean(acc, reduce_axes),
                 "learning_rate": self.schedule(state.step),
             }
             return TrainState(step=state.step + 1, params=params,
@@ -186,8 +207,8 @@ class Trainer:
                                     images, train=False)
             loss = cross_entropy(logits, labels)
             acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
-            return (jax.lax.pmean(loss, DATA_AXIS),
-                    jax.lax.pmean(acc, DATA_AXIS))
+            return (jax.lax.pmean(loss, reduce_axes),
+                    jax.lax.pmean(acc, reduce_axes))
 
         state_spec = rep
 
