@@ -28,6 +28,7 @@ LM_DEFAULTS = dict(
     train_epochs=1,
     batch_size=8,
     dtype="bf16",
+    optimizer="adamw",     # warmup+cosine LM recipe (schedules.lm_schedule)
     skip_eval=True,
 )
 

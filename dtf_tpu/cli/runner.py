@@ -8,6 +8,7 @@ dict (logged as "Run stats:" like resnet_imagenet_main.py:278).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import shutil
@@ -114,12 +115,8 @@ def run(cfg: Config) -> dict:
     train_iter = train_fn()
     first = next(train_iter)
     state = trainer.init_state(jax.random.key(cfg.seed), first)
-
-    def chained():
-        yield first
-        yield from train_iter
-
-    prefetched = DevicePrefetcher(chained(), rt, buffer_size=2)
+    prefetched = DevicePrefetcher(itertools.chain([first], train_iter), rt,
+                                  buffer_size=2)
 
     callbacks = []
     ckpt_mod = None

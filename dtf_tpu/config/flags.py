@@ -108,6 +108,10 @@ class Config:
     seq_parallelism: int = 1            # size of the 'seq' mesh axis (ring attention)
     sync_bn: bool = False               # cross-replica BN (reference default: per-replica)
 
+    # --- optimizer ---
+    optimizer: str = "sgd"              # sgd (reference, common.py:169-172)
+                                        # | adamw (transformer LM recipe)
+
     # --- misc ---
     seed: int = 0
     verbose: int = 2                    # keras fit verbose parity (rank-gated)
@@ -122,6 +126,9 @@ class Config:
         if self.ps_mode not in ("sync", "async"):
             raise ValueError(
                 f"unknown ps_mode {self.ps_mode!r}; choose sync or async")
+        if self.optimizer not in ("sgd", "momentum", "adamw"):
+            raise ValueError(
+                f"unknown optimizer {self.optimizer!r}; choose sgd or adamw")
 
     # -- dtype helpers -------------------------------------------------
     @property

@@ -250,6 +250,7 @@ void PsServer::accept_loop() {
   for (;;) {
     int fd = accept(listen_fd, nullptr, nullptr);
     if (fd < 0) {
+      if (errno == EINTR) continue;  // same SA_RESTART exposure as recv
       std::lock_guard<std::mutex> lk(state_mu);
       if (stopping) return;
       return;  // listen socket closed/broken

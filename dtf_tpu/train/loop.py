@@ -40,7 +40,7 @@ from dtf_tpu.data.base import DatasetSpec
 from dtf_tpu.models.registry import l2_weight_penalty
 from dtf_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS, MeshRuntime
 from dtf_tpu.train import schedules as sched_lib
-from dtf_tpu.train.optimizer import keras_sgd
+from dtf_tpu.train.optimizer import build_optimizer
 from dtf_tpu.utils.logs import TimeHistory, build_stats
 
 log = logging.getLogger("dtf_tpu")
@@ -112,8 +112,9 @@ class Trainer:
         else:
             self.schedule = sched_lib.for_dataset(
                 spec.name, self.global_batch, max(self.steps_per_epoch, 1),
-                spec.num_train, use_tensor_lr=cfg.use_tensor_lr)
-        self.tx = keras_sgd(self.schedule, momentum=0.9)
+                spec.num_train, use_tensor_lr=cfg.use_tensor_lr,
+                train_epochs=self.train_epochs)
+        self.tx = build_optimizer(cfg.optimizer, self.schedule)
         self.loss_scale = cfg.loss_scale_value
 
         self._build_steps()

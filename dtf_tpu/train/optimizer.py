@@ -49,8 +49,36 @@ def keras_sgd(learning_rate: Callable, momentum: float = 0.9
     return optax.GradientTransformation(init, update)
 
 
+class AdamWState(NamedTuple):
+    adam: optax.OptState
+
+
+def adamw(learning_rate: Callable, b1: float = 0.9, b2: float = 0.95,
+          eps: float = 1e-8, weight_decay: float = 0.1
+          ) -> optax.GradientTransformation:
+    """AdamW with decoupled weight decay and a step-dependent LR — the
+    transformer-LM optimizer (no reference counterpart; the reference is
+    SGD-only).  Same `update(..., step=)` contract as keras_sgd."""
+    base = optax.scale_by_adam(b1=b1, b2=b2, eps=eps)
+
+    def init(params):
+        return AdamWState(adam=base.init(params))
+
+    def update(grads, state, params=None, *, step):
+        updates, adam_state = base.update(grads, state.adam, params)
+        lr = learning_rate(step)
+        updates = jax.tree_util.tree_map(
+            lambda u, p: (-lr * (u + weight_decay * p)).astype(p.dtype),
+            updates, params)
+        return updates, AdamWState(adam=adam_state)
+
+    return optax.GradientTransformation(init, update)
+
+
 def build_optimizer(name: str, learning_rate: Callable,
                     momentum: float = 0.9) -> optax.GradientTransformation:
     if name in ("sgd", "momentum"):
         return keras_sgd(learning_rate, momentum)
+    if name == "adamw":
+        return adamw(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")

@@ -133,6 +133,25 @@ def horovod_schedule(num_replicas: int, steps_per_epoch: int,
     return fn
 
 
+def lm_schedule(total_steps: int, peak_lr: float = 3e-4,
+                final_frac: float = 0.1) -> Schedule:
+    """Standard LM recipe (no reference counterpart — the reference is
+    vision-only): linear warmup over the first tenth of training (capped
+    at 2000 steps) then cosine decay to `final_frac` of the peak."""
+    warmup = max(1, min(2000, total_steps // 10))
+    decay_steps = max(total_steps - warmup, 1)
+
+    def fn(step):
+        step = step.astype(jnp.float32)
+        warm = peak_lr * step / warmup
+        progress = jnp.clip((step - warmup) / decay_steps, 0.0, 1.0)
+        cos = final_frac + (1 - final_frac) * 0.5 * (
+            1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup, warm, peak_lr * cos).astype(jnp.float32)
+
+    return fn
+
+
 def constant(lr: float) -> Schedule:
     def fn(step):
         return jnp.float32(lr)
@@ -140,9 +159,12 @@ def constant(lr: float) -> Schedule:
 
 
 def for_dataset(dataset: str, batch_size: int, steps_per_epoch: int,
-                epoch_size: int, use_tensor_lr: bool = False) -> Schedule:
+                epoch_size: int, use_tensor_lr: bool = False,
+                train_epochs: int = 1) -> Schedule:
     if dataset.startswith("cifar"):
         return cifar_schedule(batch_size, steps_per_epoch)
+    if dataset == "lm":
+        return lm_schedule(steps_per_epoch * max(train_epochs, 1))
     if use_tensor_lr:
         return piecewise_constant_with_warmup(batch_size, epoch_size)
     return imagenet_schedule(batch_size, steps_per_epoch)

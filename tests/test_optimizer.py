@@ -33,3 +33,27 @@ def test_velocity_dtype_matches_params():
     params = {"a": jnp.zeros((2, 2), jnp.float32)}
     state = tx.init(params)
     assert state.velocity["a"].dtype == jnp.float32
+
+
+def test_adamw_decoupled_decay():
+    """weight_decay applies to params, not through the Adam moments."""
+    import jax.numpy as jnp
+    from dtf_tpu.train.optimizer import adamw, build_optimizer
+
+    tx = adamw(lambda s: jnp.float32(0.1), weight_decay=0.5)
+    params = {"w": jnp.ones((2,))}
+    state = tx.init(params)
+    grads = {"w": jnp.zeros((2,))}
+    updates, state = tx.update(grads, state, params, step=jnp.asarray(0))
+    # zero grads: update is pure decoupled decay = -lr * wd * p
+    np.testing.assert_allclose(np.asarray(updates["w"]),
+                               -0.1 * 0.5 * np.ones(2), rtol=1e-6)
+
+
+def test_build_optimizer_dispatch():
+    import jax.numpy as jnp
+    from dtf_tpu.train.optimizer import build_optimizer
+    import pytest
+    assert build_optimizer("adamw", lambda s: jnp.float32(1e-3)) is not None
+    with pytest.raises(ValueError):
+        build_optimizer("lion", lambda s: jnp.float32(1e-3))

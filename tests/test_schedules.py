@@ -107,3 +107,19 @@ def test_horovod_schedule_warmup_and_plateau():
     assert mid == pytest.approx(0.1 + (0.1 * size - 0.1) * 0.5)
     for step in (3 * spe, 5 * spe, 100 * spe):
         assert float(fn(jnp.asarray(step))) == pytest.approx(0.1 * size)
+
+
+def test_lm_schedule_shape():
+    """Warmup to peak, cosine to final_frac*peak."""
+    fn = schedules.lm_schedule(10_000, peak_lr=3e-4)
+    warmup = min(2000, 10_000 // 10)
+    assert float(fn(jnp.asarray(0))) == pytest.approx(0.0)
+    assert float(fn(jnp.asarray(warmup))) == pytest.approx(3e-4, rel=1e-3)
+    assert float(fn(jnp.asarray(10_000))) == pytest.approx(3e-4 * 0.1, rel=1e-3)
+    mid = float(fn(jnp.asarray((warmup + 10_000) // 2)))
+    assert 3e-4 * 0.1 < mid < 3e-4
+
+
+def test_for_dataset_lm_dispatch():
+    fn = schedules.for_dataset("lm", 256, 1000, 100_000, train_epochs=2)
+    assert float(fn(jnp.asarray(2000))) > 0
