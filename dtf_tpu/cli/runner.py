@@ -20,7 +20,7 @@ from dtf_tpu.data import DatasetSpec, get_dataset_spec, synthetic_input_fn
 from dtf_tpu.data.pipeline import DevicePrefetcher
 from dtf_tpu.models import build_model
 from dtf_tpu.runtime import initialize, is_coordinator
-from dtf_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS
+from dtf_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from dtf_tpu.train import Trainer
 
 log = logging.getLogger("dtf_tpu")
@@ -105,11 +105,20 @@ def run(cfg: Config) -> dict:
     model_name = "trivial" if cfg.use_trivial_model else cfg.model
     seq_axis = (SEQ_AXIS if spec.is_sequence and cfg.seq_parallelism > 1
                 else None)
+    model_axis = (MODEL_AXIS if model_name.startswith("transformer")
+                  and cfg.model_parallelism > 1 else None)
     model, l2 = build_model(
         model_name, num_classes=spec.num_classes, dtype=cfg.compute_dtype,
-        bn_axis=DATA_AXIS if cfg.sync_bn else None, seq_axis=seq_axis)
+        bn_axis=DATA_AXIS if cfg.sync_bn else None, seq_axis=seq_axis,
+        model_axis=model_axis)
 
-    trainer = Trainer(cfg, rt, model, l2, spec)
+    param_spec_fn = None
+    if model_axis is not None:
+        import functools
+        from dtf_tpu.models.transformer import param_partition_specs
+        param_spec_fn = functools.partial(param_partition_specs,
+                                          model_axis=model_axis)
+    trainer = Trainer(cfg, rt, model, l2, spec, param_spec_fn=param_spec_fn)
     train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)
 
     train_iter = train_fn()

@@ -43,20 +43,24 @@ _REGISTRY = {
 
 def build_model(name: str, num_classes: int | None = None,
                 dtype: Any = jnp.float32, bn_axis: str | None = None,
-                seq_axis: str | None = None, **model_kw):
+                seq_axis: str | None = None, model_axis: str | None = None,
+                **model_kw):
     """Returns (module, l2_weight).
 
     `bn_axis` names the mesh axis for cross-replica (sync) BatchNorm;
     None = per-replica statistics, the reference's implicit
     MirroredStrategy behavior (SURVEY §7.4).  `seq_axis` names the mesh
     axis the sequence dimension is sharded over (transformer family
-    only) — it switches attention to the ring implementation."""
+    only) — it switches attention to the ring implementation.
+    `model_axis` enables Megatron-style tensor parallelism (transformer
+    family only): heads/ff sharded; pair with
+    transformer.param_partition_specs."""
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
     ctor, default_classes, l2 = _REGISTRY[name]
     if name.startswith("transformer"):
         kw = dict(vocab_size=num_classes or default_classes, dtype=dtype,
-                  seq_axis=seq_axis, **model_kw)
+                  seq_axis=seq_axis, model_axis=model_axis, **model_kw)
     else:
         kw = dict(num_classes=num_classes or default_classes, dtype=dtype,
                   **model_kw)

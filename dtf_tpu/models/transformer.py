@@ -16,8 +16,18 @@ Design (TPU-first):
     its sequence dimension sharded: attention switches to the K/V ring
     (ICI neighbor exchange overlapped with compute) and position
     embeddings are offset by the shard's global position.
+  - `model_axis` set ⇒ Megatron-style tensor parallelism: qkv and fc1
+    are column-parallel (heads / ff dim sharded — the param arrays this
+    module receives inside shard_map are the local shards), out and fc2
+    are row-parallel with a `psum` forward; `tp_region` (identity
+    forward, psum backward) guards each region entry so upstream
+    LayerNorm/embedding gradients stay correct.  Composes freely with
+    the seq ring (heads never communicate during attention).
   - optional `remat` wraps each block in `jax.checkpoint`, trading
     FLOPs for HBM (the standard long-context memory lever).
+
+Use `param_partition_specs(params)` for the per-leaf PartitionSpecs
+that shard a full (replicated-shape) param tree onto the 'model' axis.
 """
 
 from __future__ import annotations
@@ -29,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from dtf_tpu.ops.flash_attention import flash_attention
+from dtf_tpu.parallel.collectives import tp_region
 from dtf_tpu.parallel.ring_attention import ring_attention
 
 
@@ -36,15 +47,27 @@ class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None   # set when seq dim is mesh-sharded
+    model_axis: Optional[str] = None  # set when heads are mesh-sharded
     use_pallas: Any = None           # None=auto; False forces blockwise-JAX
 
     @nn.compact
     def __call__(self, x):
         b, s, d = x.shape
         head_dim = d // self.num_heads
-        qkv = nn.DenseGeneral((3, self.num_heads, head_dim), dtype=self.dtype,
+        heads = self.num_heads
+        if self.model_axis is not None:
+            x = tp_region(x, self.model_axis)
+            # lax.psum of a Python scalar is the static axis size, so
+            # the local head count is a concrete feature dim
+            mp = jax.lax.psum(1, self.model_axis)
+            if heads % mp:
+                raise ValueError(
+                    f"num_heads {heads} not divisible by "
+                    f"model_parallelism {mp}")
+            heads //= mp
+        qkv = nn.DenseGeneral((3, heads, head_dim), dtype=self.dtype,
                               name="qkv")(x)
-        q, k, v = (qkv[..., i, :, :] for i in range(3))  # [B, S, H, Dh]
+        q, k, v = (qkv[..., i, :, :] for i in range(3))  # [B, S, Hloc, Dh]
         if self.seq_axis is not None:
             # sequence-parallel: K/V rotate around the 'seq' ring; every
             # query still attends to the full global sequence
@@ -52,8 +75,13 @@ class CausalSelfAttention(nn.Module):
         else:
             o = flash_attention(q, k, v, causal=True,
                                 use_pallas=self.use_pallas)
-        o = o.reshape(b, s, d)
-        return nn.Dense(d, dtype=self.dtype, name="out")(o)
+        o = o.reshape(b, s, -1)
+        # row-parallel: each shard contributes its heads' slice; no bias
+        # (a replicated bias would be summed mp times by the psum)
+        out = nn.Dense(d, dtype=self.dtype, use_bias=False, name="out")(o)
+        if self.model_axis is not None:
+            out = jax.lax.psum(out, self.model_axis)
+        return out
 
 
 class Block(nn.Module):
@@ -61,6 +89,7 @@ class Block(nn.Module):
     d_ff: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
+    model_axis: Optional[str] = None
     use_pallas: Any = None
 
     @nn.compact
@@ -69,11 +98,22 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + CausalSelfAttention(
             self.num_heads, dtype=self.dtype, seq_axis=self.seq_axis,
-            use_pallas=self.use_pallas, name="attn")(h)
+            model_axis=self.model_axis, use_pallas=self.use_pallas,
+            name="attn")(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        h = nn.Dense(self.d_ff, dtype=self.dtype, name="fc1")(h)
+        d_ff = self.d_ff
+        if self.model_axis is not None:
+            h = tp_region(h, self.model_axis)
+            mp = jax.lax.psum(1, self.model_axis)
+            if d_ff % mp:
+                raise ValueError(
+                    f"d_ff {d_ff} not divisible by model_parallelism {mp}")
+            d_ff //= mp
+        h = nn.Dense(d_ff, dtype=self.dtype, name="fc1")(h)  # column
         h = nn.gelu(h)
-        h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        h = nn.Dense(d, dtype=self.dtype, use_bias=False, name="fc2")(h)  # row
+        if self.model_axis is not None:
+            h = jax.lax.psum(h, self.model_axis)
         return x + h
 
 
@@ -90,6 +130,7 @@ class TransformerLM(nn.Module):
     max_seq_len: int = 2048
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
+    model_axis: Optional[str] = None
     use_pallas: Any = None
     remat: bool = False
 
@@ -115,9 +156,37 @@ class TransformerLM(nn.Module):
             block = nn.remat(Block)
         for i in range(self.num_layers):
             x = block(self.num_heads, self.d_ff, dtype=self.dtype,
-                      seq_axis=self.seq_axis, use_pallas=self.use_pallas,
-                      name=f"block{i}")(x)
+                      seq_axis=self.seq_axis, model_axis=self.model_axis,
+                      use_pallas=self.use_pallas, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # lm_head stays replicated (vocab-sharding the head would shard
+        # the logits and the CE loss — a further optimization, not a
+        # capability)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype,
                           name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+def param_partition_specs(params, model_axis: str):
+    """PartitionSpec tree sharding a full TransformerLM param tree onto
+    the tensor-parallel axis: qkv kernel/bias on the head dim, fc1
+    kernel/bias on the ff dim, out/fc2 kernels on their input (row)
+    dim; everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        last = keys[-1] if keys else ""
+        if "qkv" in keys:
+            # kernel [d, 3, H, Dh] / bias [3, H, Dh]: shard H
+            return (P(None, None, model_axis, None) if last == "kernel"
+                    else P(None, model_axis, None))
+        if "fc1" in keys:
+            # kernel [d, ff] / bias [ff]: shard ff
+            return (P(None, model_axis) if last == "kernel"
+                    else P(model_axis))
+        if ("out" in keys or "fc2" in keys) and last == "kernel":
+            return P(model_axis, None)   # row-parallel input dim
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)

@@ -22,6 +22,8 @@ axis-bound) computation.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 from jax import lax
 
@@ -65,6 +67,31 @@ def ring_shift(x, axis_name: str, shift: int = 1):
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.tree_util.tree_map(
         lambda a: lax.ppermute(a, axis_name, perm), x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region(x, axis_name: str):
+    """Megatron's `f` operator — enter a tensor-parallel region.
+
+    Forward: identity.  Backward: all-reduce the cotangent over the
+    tensor-parallel axis.  Needed because a column-parallel layer's
+    input cotangent is partial (each shard back-propagates only its
+    slice of the weight); without the psum every parameter *upstream*
+    of the TP region (LayerNorm, embeddings) would get wrong gradients.
+    The matching exit operator is plain `lax.psum` (sum forward,
+    identity backward — exactly the row-parallel output semantics)."""
+    return x
+
+
+def _tp_region_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_region_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+tp_region.defvjp(_tp_region_fwd, _tp_region_bwd)
 
 
 def broadcast_from(x, axis_name: str, root: int = 0):

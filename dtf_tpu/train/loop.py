@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import struct
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dtf_tpu.config import Config
 from dtf_tpu.data.base import DatasetSpec
@@ -66,12 +66,22 @@ class Trainer:
 
     def __init__(self, cfg: Config, runtime: MeshRuntime, model,
                  l2_weight: float, spec: DatasetSpec,
-                 schedule: Optional[Callable] = None):
+                 schedule: Optional[Callable] = None,
+                 param_spec_fn: Optional[Callable] = None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
         self.l2_weight = l2_weight
         self.spec = spec
+        # tensor parallelism: fn(params) -> PartitionSpec tree sharding
+        # params over the 'model' axis (e.g. transformer.
+        # param_partition_specs).  The L2 penalty sums over param leaves
+        # and would silently under-count sharded kernels.
+        self.param_spec_fn = param_spec_fn
+        if param_spec_fn is not None and l2_weight:
+            raise ValueError(
+                "tensor-parallel param sharding does not support the L2 "
+                "penalty (sharded kernels would be under-counted)")
 
         # ---- epoch math (SURVEY §3.3/3.4 steps//size semantics) ----
         # cfg.batch_size is the GLOBAL batch. In horovod/parameter_server
@@ -117,7 +127,10 @@ class Trainer:
         self.tx = build_optimizer(cfg.optimizer, self.schedule)
         self.loss_scale = cfg.loss_scale_value
 
-        self._build_steps()
+        if self.param_spec_fn is None:
+            self._build_steps()
+        # else: the state spec tree needs the concrete param structure —
+        # steps are built in init_state
 
     # ------------------------------------------------------------------
     def init_state(self, rng: jax.Array, sample_batch) -> TrainState:
@@ -126,12 +139,15 @@ class Trainer:
         every process initializes from the same seed, so params are
         identical without a broadcast."""
         images = jnp.asarray(sample_batch[0][:1])
-        # a seq-sharded module calls lax.axis_index and can only run
-        # inside shard_map; param shapes don't depend on seq_axis, so
-        # init with an unsharded twin
+        # a seq- or model-sharded module calls collectives and can only
+        # run inside shard_map; param *shapes* don't depend on those
+        # axes (TP shards arrive by sharding the full arrays), so init
+        # with an unsharded twin
         init_model = self.model
-        if getattr(init_model, "seq_axis", None) is not None:
-            init_model = init_model.clone(seq_axis=None)
+        clone_kw = {k: None for k in ("seq_axis", "model_axis")
+                    if getattr(init_model, k, None) is not None}
+        if clone_kw:
+            init_model = init_model.clone(**clone_kw)
         variables = jax.jit(init_model.init, static_argnames=("train",))(
             rng, images, train=False)
         params = variables["params"]
@@ -139,8 +155,28 @@ class Trainer:
         opt_state = self.tx.init(params)
         state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
                            batch_stats=batch_stats, opt_state=opt_state)
-        # replicate across the mesh
-        return jax.device_put(state, self.rt.replicated())
+        if self.param_spec_fn is None:
+            # replicate across the mesh
+            return jax.device_put(state, self.rt.replicated())
+        # tensor parallelism: per-leaf shardings; kernels/moments split
+        # over the 'model' axis, everything else replicated
+        state_specs = self._make_state_specs(state)
+        self._build_steps(state_specs)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.rt.mesh, s), state_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(state, shardings)
+
+    def _make_state_specs(self, state: TrainState):
+        from dtf_tpu.train.optimizer import opt_state_specs
+        pspecs = self.param_spec_fn(state.params)
+        rep = P()
+        return TrainState(
+            step=rep,
+            params=pspecs,
+            batch_stats=jax.tree_util.tree_map(lambda _: rep,
+                                               state.batch_stats),
+            opt_state=opt_state_specs(self.cfg.optimizer, pspecs, rep))
 
     # ------------------------------------------------------------------
     def _apply(self, params, batch_stats, images, train):
@@ -155,7 +191,7 @@ class Trainer:
             return out, new_stats
         return self.model.apply(variables, images, train=False), batch_stats
 
-    def _build_steps(self):
+    def _build_steps(self, state_specs=None):
         mesh = self.rt.mesh
         # token data shards [B, S] over (data, seq); vision shards dim 0
         if self.spec.is_sequence:
@@ -211,17 +247,18 @@ class Trainer:
             return (jax.lax.pmean(loss, reduce_axes),
                     jax.lax.pmean(acc, reduce_axes))
 
-        state_spec = rep
+        # replicated prefix by default; a full per-leaf tree under TP
+        state_spec = rep if state_specs is None else state_specs
 
         train_sharded = jax.shard_map(
             local_train_step, mesh=mesh,
             in_specs=(state_spec, data_spec, data_spec),
-            out_specs=(state_spec, state_spec),
+            out_specs=(state_spec, rep),
             check_vma=False)
         eval_sharded = jax.shard_map(
             local_eval_step, mesh=mesh,
             in_specs=(state_spec, data_spec, data_spec),
-            out_specs=(state_spec, state_spec),
+            out_specs=(rep, rep),
             check_vma=False)
 
         self.train_step = jax.jit(train_sharded, donate_argnums=(0,))

@@ -82,3 +82,14 @@ def build_optimizer(name: str, learning_rate: Callable,
     if name == "adamw":
         return adamw(learning_rate)
     raise ValueError(f"unknown optimizer {name!r}")
+
+
+def opt_state_specs(name: str, param_specs, replicated):
+    """PartitionSpec tree matching the optimizer state's structure, for
+    tensor-parallel runs: moment buffers shard like their params."""
+    if name in ("sgd", "momentum"):
+        return KerasSGDState(velocity=param_specs)
+    if name == "adamw":
+        return AdamWState(adam=optax.ScaleByAdamState(
+            count=replicated, mu=param_specs, nu=param_specs))
+    raise ValueError(f"unknown optimizer {name!r}")
