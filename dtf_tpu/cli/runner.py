@@ -94,30 +94,69 @@ def run(cfg: Config) -> dict:
 
     rt = initialize(cfg)
     spec = get_dataset_spec(cfg.dataset)
+    import dataclasses
     if cfg.num_classes:
-        import dataclasses
         spec = dataclasses.replace(spec, num_classes=cfg.num_classes)
+    if cfg.seq_len and spec.is_sequence:
+        spec = dataclasses.replace(spec, seq_len=cfg.seq_len)
 
     global_batch = effective_global_batch(cfg, rt)
     cfg = cfg.replace(batch_size=global_batch)
 
     rt.shard_seq = spec.is_sequence
     model_name = "trivial" if cfg.use_trivial_model else cfg.model
+    is_moe = model_name.startswith("moe_transformer")
+    is_pipeline = model_name.startswith("pipeline_transformer")
     seq_axis = (SEQ_AXIS if spec.is_sequence and cfg.seq_parallelism > 1
                 else None)
     model_axis = (MODEL_AXIS if model_name.startswith("transformer")
                   and cfg.model_parallelism > 1 else None)
+    # the 'model' axis doubles as the pipeline-stage axis for the
+    # stacked-block family
+    pipe_axis = (MODEL_AXIS if is_pipeline and cfg.model_parallelism > 1
+                 else None)
+    # experts ride the batch-splitting axis (classic DeepSpeed-MoE/GShard
+    # expert-parallel placement); harmless when that axis has size 1
+    expert_axis = DATA_AXIS if is_moe else None
+    if is_pipeline and cfg.seq_parallelism > 1:
+        raise ValueError(
+            "pipeline_transformer does not compose with seq_parallelism; "
+            "use the plain transformer for ring attention")
+    if is_moe and cfg.model_parallelism > 1:
+        raise ValueError(
+            "moe_transformer does not use the 'model' axis (experts "
+            "already shard the ff computation over 'data'); drop "
+            "--model_parallelism")
+    # None flags defer to the model preset's own defaults (the registry
+    # partials, e.g. moe_transformer_small's 4 experts)
+    model_kw = {}
+    if is_moe:
+        model_kw = {k: v for k, v in dict(
+            num_experts=cfg.num_experts,
+            capacity_factor=cfg.moe_capacity_factor,
+            aux_weight=cfg.moe_aux_weight).items() if v is not None}
+    elif is_pipeline and cfg.num_microbatches is not None:
+        model_kw = dict(num_microbatches=cfg.num_microbatches)
     model, l2 = build_model(
         model_name, num_classes=spec.num_classes, dtype=cfg.compute_dtype,
         bn_axis=DATA_AXIS if cfg.sync_bn else None, seq_axis=seq_axis,
-        model_axis=model_axis)
+        model_axis=model_axis, expert_axis=expert_axis, pipe_axis=pipe_axis,
+        **model_kw)
 
+    import functools
     param_spec_fn = None
     if model_axis is not None:
-        import functools
         from dtf_tpu.models.transformer import param_partition_specs
         param_spec_fn = functools.partial(param_partition_specs,
                                           model_axis=model_axis)
+    elif is_moe:
+        from dtf_tpu.models.moe import moe_param_partition_specs
+        param_spec_fn = functools.partial(moe_param_partition_specs,
+                                          expert_axis=expert_axis)
+    elif pipe_axis is not None:
+        from dtf_tpu.models.pipeline_lm import pipeline_param_partition_specs
+        param_spec_fn = functools.partial(pipeline_param_partition_specs,
+                                          pipe_axis=pipe_axis)
     trainer = Trainer(cfg, rt, model, l2, spec, param_spec_fn=param_spec_fn)
     train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)
 
@@ -144,7 +183,12 @@ def run(cfg: Config) -> dict:
         # write of the replicated state — the rank-0-write equivalent)
         ckpt_cb = ckpt_mod.CheckpointCallback(cfg.model_dir)
         if cfg.resume:
-            restored = ckpt_cb.ckpt.restore(state, sharding=rt.replicated())
+            # restore with the state's own per-leaf shardings (TP/EP/PP
+            # states are not replicated — a blanket replicated sharding
+            # would silently unshard them)
+            state_shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding, state)
+            restored = ckpt_cb.ckpt.restore(state, sharding=state_shardings)
             if restored is not None:
                 state = restored
             else:

@@ -90,6 +90,7 @@ class Config:
     model: str = ""                     # resnet50 | resnet56|resnet20|resnet32|resnet110 | trivial
     dataset: str = ""                   # cifar10 | imagenet
     num_classes: Optional[int] = None   # override (imagenet: 1001, cifar: 10)
+    seq_len: Optional[int] = None       # override the LM dataset's sequence length
 
     # --- distribution / topology (TF_CONFIG successor) ---
     distribution_strategy: str = "mirrored"  # --distribution_strategy
@@ -107,6 +108,15 @@ class Config:
     model_parallelism: int = 1          # size of the 'model' mesh axis
     seq_parallelism: int = 1            # size of the 'seq' mesh axis (ring attention)
     sync_bn: bool = False               # cross-replica BN (reference default: per-replica)
+
+    # --- mixture-of-experts (moe_transformer family) ---
+    # None = the model preset's own default (e.g. moe_transformer_small
+    # ships 4 experts); set a value to override it
+    num_experts: Optional[int] = None   # total experts; sharded over 'data' (EP)
+    moe_capacity_factor: Optional[float] = None  # per-expert capacity multiplier
+    moe_aux_weight: Optional[float] = None  # load-balance aux-loss weight
+    # --- pipeline parallelism (pipeline_transformer family) ---
+    num_microbatches: Optional[int] = None  # GPipe microbatches per step
 
     # --- optimizer ---
     optimizer: str = "sgd"              # sgd (reference, common.py:169-172)

@@ -1,0 +1,253 @@
+"""Mixture-of-Experts transformer — expert parallelism over the mesh.
+
+No reference counterpart (the reference is dense vision-only, SURVEY.md
+§2.2 lists EP/MoE as an explicit absence); this closes that axis of the
+parallelism matrix TPU-first.
+
+Design (GShard/Switch lineage, re-expressed for XLA):
+  - **Static-shape dispatch.** Routing never gathers with dynamic
+    shapes: a top-2 router builds dense one-hot dispatch/combine
+    tensors ``[tokens, experts, capacity]`` (capacity is a Python int
+    at trace time), and tokens move to experts as two einsums — pure
+    MXU work that XLA tiles freely.  Tokens beyond an expert's
+    capacity are dropped (their MoE output is 0; the residual carries
+    them), exactly the GShard overflow rule.
+  - **Expert parallelism rides the 'data' axis.** Experts shard over
+    the same mesh axis the batch is sharded over (the classic
+    DeepSpeed-MoE/GShard placement): each data shard holds
+    ``E / ep`` experts, and two tiled ``lax.all_to_all`` collectives
+    (ICI) exchange capacity slots so every expert sees the tokens
+    routed to it from the whole expert group.  No parameter or
+    optimizer-state duplication for experts — per-device HBM holds
+    only the local experts.
+  - **Router in fp32** (softmax numerics), expert matmuls in the
+    compute dtype (bf16 on TPU), combine in fp32.
+  - **Aux load-balance loss** (Switch §2.2 form: ``E · Σ f_e · p_e``)
+    is sown into the ``aux_loss`` collection; the Trainer adds every
+    sown aux term to the objective.
+
+Gradient contract (enforced by ``Trainer`` via
+``moe_param_partition_specs``): expert leaves are sharded over 'data',
+so their local grads — which reverse-mode all_to_all already sums
+across the expert group — are divided by the data-axis size instead of
+being pmean-ed (a pmean would average *different experts'* grads).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dtf_tpu.models.transformer import Block, CausalSelfAttention
+
+
+class MoEMLP(nn.Module):
+    """Top-2 routed expert MLP with static capacity.
+
+    Call with ``x: [batch, seq, d_model]``; returns the same shape.
+    ``expert_axis`` names the mesh axis experts are sharded over (the
+    module must then run inside shard_map and receive its local expert
+    shards); None means all experts live on every device.
+    """
+
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    expert_axis: Optional[str] = None
+    aux_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e = self.num_experts
+        tokens = x.reshape(b * s, d)
+        n = b * s
+
+        ep = 1
+        e_loc = e
+        if self.expert_axis is not None:
+            ep = lax.psum(1, self.expert_axis)  # static axis size
+            if e % ep:
+                raise ValueError(
+                    f"num_experts {e} not divisible by expert-parallel "
+                    f"group size {ep}")
+            e_loc = e // ep
+
+        k_init = nn.initializers.lecun_normal(batch_axis=(0,))
+        w1 = self.param("w1", k_init, (e_loc, d, self.d_ff))
+        b1 = self.param("b1", nn.initializers.zeros, (e_loc, self.d_ff))
+        w2 = self.param("w2", k_init, (e_loc, self.d_ff, d))
+        b2 = self.param("b2", nn.initializers.zeros, (e_loc, d))
+
+        # ---- router (fp32) ------------------------------------------
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # [n, E]
+
+        idx1 = jnp.argmax(probs, axis=-1)
+        m1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)    # [n, E]
+        idx2 = jnp.argmax(probs * (1.0 - m1), axis=-1)
+        m2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+
+        # load balance: fraction routed (first choice) × mean prob
+        frac = jnp.mean(m1, axis=0)
+        p_mean = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(frac * p_mean)
+        self.sow("aux_loss", "load_balance", self.aux_weight * aux)
+
+        # ---- capacity positions (static C) --------------------------
+        cap = max(1, min(n, int(round(self.capacity_factor * 2 * n / e))))
+        pos1 = jnp.sum((jnp.cumsum(m1, axis=0) - m1) * m1, axis=-1)  # [n]
+        count1 = jnp.sum(m1, axis=0, keepdims=True)        # [1, E]
+        pos2 = jnp.sum((jnp.cumsum(m2, axis=0) - m2 + count1) * m2, axis=-1)
+        keep1 = (pos1 < cap).astype(jnp.float32)
+        keep2 = (pos2 < cap).astype(jnp.float32)
+
+        g1 = jnp.sum(probs * m1, axis=-1) * keep1
+        g2 = jnp.sum(probs * m2, axis=-1) * keep2
+        denom = jnp.where(g1 + g2 > 0, g1 + g2, 1.0)
+        g1, g2 = g1 / denom, g2 / denom
+
+        # one_hot of an out-of-range position is all-zero, so dropped
+        # tokens vanish from dispatch/combine automatically
+        oh1 = jax.nn.one_hot(pos1.astype(jnp.int32), cap,
+                             dtype=jnp.float32) * keep1[:, None]
+        oh2 = jax.nn.one_hot(pos2.astype(jnp.int32), cap,
+                             dtype=jnp.float32) * keep2[:, None]
+        dispatch = (m1[:, :, None] * oh1[:, None, :]
+                    + m2[:, :, None] * oh2[:, None, :])    # [n, E, C]
+        combine = (g1[:, None, None] * m1[:, :, None] * oh1[:, None, :]
+                   + g2[:, None, None] * m2[:, :, None] * oh2[:, None, :])
+        dispatch = lax.stop_gradient(dispatch)
+
+        # ---- dispatch → experts → combine ---------------------------
+        xin = jnp.einsum("nec,nd->ecd", dispatch,
+                         tokens.astype(jnp.float32)).astype(self.dtype)
+        if self.expert_axis is not None and ep > 1:
+            # NETWORK BOUNDARY: exchange capacity slots across the
+            # expert group so each device holds its local experts'
+            # tokens from every peer — [E, C, d] → [E/ep, ep·C, d]
+            xin = lax.all_to_all(xin, self.expert_axis, split_axis=0,
+                                 concat_axis=1, tiled=True)
+        h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(self.dtype))
+        h = nn.gelu(h + b1[:, None, :].astype(self.dtype))
+        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
+        out = out + b2[:, None, :].astype(self.dtype)
+        if self.expert_axis is not None and ep > 1:
+            # inverse exchange: [E/ep, ep·C, d] → [E, C, d]
+            out = lax.all_to_all(out, self.expert_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)
+        y = jnp.einsum("nec,ecd->nd", combine,
+                       out.astype(jnp.float32))
+        return y.reshape(b, s, d).astype(x.dtype)
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN block: causal attention + routed-expert MLP."""
+
+    num_heads: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    expert_axis: Optional[str] = None
+    aux_weight: float = 0.01
+    use_pallas: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, dtype=self.dtype, seq_axis=self.seq_axis,
+            use_pallas=self.use_pallas, name="attn")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        return x + MoEMLP(
+            self.num_experts, self.d_ff,
+            capacity_factor=self.capacity_factor, dtype=self.dtype,
+            expert_axis=self.expert_axis, aux_weight=self.aux_weight,
+            name="moe")(h)
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with routed-expert MLPs every ``moe_every``-th
+    block (the interleaved dense/MoE stacking of GShard/ST-MoE).
+
+    Composes with sequence parallelism (``seq_axis``: ring attention;
+    routing is per-token and needs no cross-shard coordination) — but
+    not with Megatron tensor parallelism (experts already shard the ff
+    computation)."""
+
+    vocab_size: int
+    num_layers: int = 12
+    d_model: int = 512
+    num_heads: int = 8
+    d_ff: int = 2048
+    num_experts: int = 8
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 0.01
+    max_seq_len: int = 2048
+    dtype: Any = jnp.float32
+    seq_axis: Optional[str] = None
+    expert_axis: Optional[str] = None
+    use_pallas: Any = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        del train  # LN only — same train/eval behavior
+        b, s_local = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="embed")(tokens)
+        pos_table = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_seq_len, self.d_model))
+        offset = 0
+        if self.seq_axis is not None:
+            offset = lax.axis_index(self.seq_axis) * s_local
+        x = x + lax.dynamic_slice_in_dim(
+            pos_table, offset, s_local).astype(self.dtype)
+
+        dense_block, moe_block = Block, MoEBlock
+        if self.remat:
+            dense_block = nn.remat(Block)
+            moe_block = nn.remat(MoEBlock)
+        for i in range(self.num_layers):
+            if (i % self.moe_every) == self.moe_every - 1:
+                x = moe_block(
+                    self.num_heads, self.d_ff, self.num_experts,
+                    capacity_factor=self.capacity_factor, dtype=self.dtype,
+                    seq_axis=self.seq_axis, expert_axis=self.expert_axis,
+                    aux_weight=self.aux_weight, use_pallas=self.use_pallas,
+                    name=f"block{i}")(x)
+            else:
+                x = dense_block(self.num_heads, self.d_ff, dtype=self.dtype,
+                                seq_axis=self.seq_axis,
+                                use_pallas=self.use_pallas,
+                                name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def moe_param_partition_specs(params, expert_axis: str):
+    """PartitionSpec tree sharding expert weights (w1/b1/w2/b2 under any
+    ``moe`` module) over the expert-parallel axis; router and all dense
+    layers replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        last = keys[-1] if keys else ""
+        if "moe" in keys and last in ("w1", "b1", "w2", "b2"):
+            return P(expert_axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)

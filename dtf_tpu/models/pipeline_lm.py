@@ -1,0 +1,179 @@
+"""Pipeline-parallel decoder-only LM.
+
+The transformer blocks are *stacked*: every block parameter carries a
+leading layer dimension, sharded over the pipeline axis (the 'model'
+mesh axis) via ``pipeline_param_partition_specs`` — stage j's device
+holds only its ``num_layers / pp`` blocks, so model depth scales
+linearly with the pipeline length.  Inside ``shard_map`` the stage is a
+``lax.scan`` over the local block stack, and stages exchange
+activations through ``parallel.pipeline.pipeline_spmd`` (GPipe schedule
+over ``lax.ppermute``).
+
+The blocks are implemented in raw JAX (explicit ``self.param`` tensors
++ functional layer math) rather than nested flax modules: the stage
+body runs under two levels of ``lax.scan`` (layers × pipeline ticks)
+where explicit parameter pytrees are the natural representation.
+
+Replicated-parameter gradients under PP use two tricks, both free of
+Trainer special-casing:
+  - embedding/positional params: only stage 0's embedding output feeds
+    the pipeline, so its cotangent lives on stage 0 alone.  Wrapping
+    the embedded input in ``tp_region`` (identity forward, psum
+    backward) hands every stage the same output-cotangent, and since
+    every stage computed the identical embedding forward, all stages
+    derive identical (correct) embedding grads — replicas stay in sync.
+  - final-norm/lm-head params: the pipeline output is mask-psum
+    broadcast (``last_stage_broadcast``) before the head, so every
+    stage computes the head on identical inputs and gets identical
+    grads directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dtf_tpu.ops.flash_attention import flash_attention
+from dtf_tpu.parallel.collectives import tp_region
+from dtf_tpu.parallel.pipeline import last_stage_broadcast, pipeline_spmd
+
+# parameter names that carry a leading stacked-layer dimension
+BLOCK_PARAMS = ("ln1_s", "ln1_b", "qkv_k", "qkv_b", "out_k", "out_b",
+                "ln2_s", "ln2_b", "fc1_k", "fc1_b", "fc2_k", "fc2_b")
+
+
+def _layernorm(x, scale, bias, eps: float = 1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+class PipelinedTransformerLM(nn.Module):
+    """Next-token LM with pipeline-stacked blocks.
+
+    ``pipe_axis`` names the mesh axis whose shards are pipeline stages
+    (None: all blocks run locally in sequence — the single-device
+    twin, numerically identical).  ``num_layers`` must divide evenly by
+    the axis size; the scan length is taken from the parameter shapes,
+    so the same module works on full or stage-local stacks."""
+
+    vocab_size: int
+    num_layers: int = 12
+    d_model: int = 512
+    num_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    num_microbatches: int = 4
+    dtype: Any = jnp.float32
+    pipe_axis: Optional[str] = None
+    use_pallas: Any = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        del train  # LN only — same train/eval behavior
+        b, s = tokens.shape
+        d, heads = self.d_model, self.num_heads
+        dh = d // heads
+        layers = self.num_layers
+        if self.pipe_axis is not None:
+            # inside shard_map each stage holds (and declares) only its
+            # local slice of the stacked block params
+            pp = lax.psum(1, self.pipe_axis)  # static axis size
+            if layers % pp:
+                raise ValueError(
+                    f"num_layers {layers} not divisible by pipeline "
+                    f"length {pp}")
+            layers //= pp
+        init = nn.initializers
+        k_init = init.lecun_normal(batch_axis=(0,))
+
+        embed = self.param("embed", init.normal(0.02), (self.vocab_size, d))
+        pos = self.param("pos_embed", init.normal(0.02),
+                         (self.max_seq_len, d))
+        blocks = dict(
+            ln1_s=self.param("ln1_s", init.ones, (layers, d)),
+            ln1_b=self.param("ln1_b", init.zeros, (layers, d)),
+            qkv_k=self.param("qkv_k", k_init, (layers, d, 3 * heads * dh)),
+            qkv_b=self.param("qkv_b", init.zeros, (layers, 3 * heads * dh)),
+            out_k=self.param("out_k", k_init, (layers, heads * dh, d)),
+            out_b=self.param("out_b", init.zeros, (layers, d)),
+            ln2_s=self.param("ln2_s", init.ones, (layers, d)),
+            ln2_b=self.param("ln2_b", init.zeros, (layers, d)),
+            fc1_k=self.param("fc1_k", k_init, (layers, d, self.d_ff)),
+            fc1_b=self.param("fc1_b", init.zeros, (layers, self.d_ff)),
+            fc2_k=self.param("fc2_k", k_init, (layers, self.d_ff, d)),
+            fc2_b=self.param("fc2_b", init.zeros, (layers, d)),
+        )
+        ln_f_s = self.param("ln_f_s", init.ones, (d,))
+        ln_f_b = self.param("ln_f_b", init.zeros, (d,))
+        head_k = self.param("head_k", init.lecun_normal(),
+                            (d, self.vocab_size))
+        head_b = self.param("head_b", init.zeros, (self.vocab_size,))
+
+        dtype = self.dtype
+        use_pallas = self.use_pallas
+
+        def block_step(h, p):
+            """One pre-LN transformer block on [mb, s, d]."""
+            bsz = h.shape[0]
+            hn = _layernorm(h, p["ln1_s"], p["ln1_b"])
+            qkv = hn @ p["qkv_k"].astype(dtype) + p["qkv_b"].astype(dtype)
+            qkv = qkv.reshape(bsz, s, 3, heads, dh)
+            q, k, v = (qkv[..., i, :, :] for i in range(3))
+            o = flash_attention(q, k, v, causal=True, use_pallas=use_pallas)
+            o = o.reshape(bsz, s, heads * dh)
+            h = h + (o @ p["out_k"].astype(dtype) + p["out_b"].astype(dtype))
+            hn = _layernorm(h, p["ln2_s"], p["ln2_b"])
+            f = nn.gelu(hn @ p["fc1_k"].astype(dtype)
+                        + p["fc1_b"].astype(dtype))
+            return h + (f @ p["fc2_k"].astype(dtype)
+                        + p["fc2_b"].astype(dtype))
+
+        def stage_fn(h):
+            # scan over this shard's block stack (leading dim of the
+            # received params — full depth off-mesh, depth/pp on it)
+            h, _ = lax.scan(lambda c, p: (block_step(c, p), None),
+                            h, blocks)
+            return h
+
+        x = embed[tokens].astype(dtype) + pos[:s].astype(dtype)
+        if self.pipe_axis is None:
+            h = stage_fn(x)
+        else:
+            if b % self.num_microbatches:
+                raise ValueError(
+                    f"per-shard batch {b} not divisible by "
+                    f"num_microbatches {self.num_microbatches}")
+            # identity forward / psum backward: keeps embedding grads
+            # identical across stages (see module docstring)
+            x = tp_region(x, self.pipe_axis)
+            mb = b // self.num_microbatches
+            h = pipeline_spmd(stage_fn,
+                              x.reshape(self.num_microbatches, mb, s, d),
+                              self.pipe_axis)
+            h = last_stage_broadcast(h.reshape(b, s, d), self.pipe_axis)
+        h = _layernorm(h, ln_f_s, ln_f_b)
+        logits = h @ head_k.astype(dtype) + head_b.astype(dtype)
+        return logits.astype(jnp.float32)
+
+
+def pipeline_param_partition_specs(params, pipe_axis: str):
+    """PartitionSpec tree: stacked block params shard their layer dim
+    over the pipeline axis; embedding/head/final-norm replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(path, leaf):
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        last = keys[-1] if keys else ""
+        if last in BLOCK_PARAMS:
+            return P(pipe_axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(rule, params)

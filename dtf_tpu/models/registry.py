@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 import functools
 
-from dtf_tpu.models import resnet, resnet_cifar, transformer, trivial
+from dtf_tpu.models import (moe, pipeline_lm, resnet, resnet_cifar,
+                            transformer, trivial)
 
 # reference weight-decay constants
 L2_IMAGENET = 1e-4  # resnet_model.py:37
@@ -38,12 +39,26 @@ _REGISTRY = {
         functools.partial(transformer.TransformerLM, num_layers=4,
                           d_model=256, num_heads=4, d_ff=1024),
         32_768, 0.0),
+    # routed-expert LM family (expert parallelism over 'data')
+    "moe_transformer": (moe.MoETransformerLM, 32_768, 0.0),
+    "moe_transformer_small": (
+        functools.partial(moe.MoETransformerLM, num_layers=4, d_model=256,
+                          num_heads=4, d_ff=1024, num_experts=4),
+        32_768, 0.0),
+    # pipeline-stacked LM family (pipeline stages over 'model')
+    "pipeline_transformer": (pipeline_lm.PipelinedTransformerLM,
+                             32_768, 0.0),
+    "pipeline_transformer_small": (
+        functools.partial(pipeline_lm.PipelinedTransformerLM, num_layers=4,
+                          d_model=256, num_heads=4, d_ff=1024),
+        32_768, 0.0),
 }
 
 
 def build_model(name: str, num_classes: int | None = None,
                 dtype: Any = jnp.float32, bn_axis: str | None = None,
                 seq_axis: str | None = None, model_axis: str | None = None,
+                expert_axis: str | None = None, pipe_axis: str | None = None,
                 **model_kw):
     """Returns (module, l2_weight).
 
@@ -54,11 +69,21 @@ def build_model(name: str, num_classes: int | None = None,
     only) — it switches attention to the ring implementation.
     `model_axis` enables Megatron-style tensor parallelism (transformer
     family only): heads/ff sharded; pair with
-    transformer.param_partition_specs."""
+    transformer.param_partition_specs.  `expert_axis` shards MoE
+    experts (moe_transformer family; pair with
+    moe.moe_param_partition_specs); `pipe_axis` makes the axis shards
+    pipeline stages (pipeline_transformer family; pair with
+    pipeline_lm.pipeline_param_partition_specs)."""
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
     ctor, default_classes, l2 = _REGISTRY[name]
-    if name.startswith("transformer"):
+    if name.startswith("moe_transformer"):
+        kw = dict(vocab_size=num_classes or default_classes, dtype=dtype,
+                  seq_axis=seq_axis, expert_axis=expert_axis, **model_kw)
+    elif name.startswith("pipeline_transformer"):
+        kw = dict(vocab_size=num_classes or default_classes, dtype=dtype,
+                  pipe_axis=pipe_axis, **model_kw)
+    elif name.startswith("transformer"):
         kw = dict(vocab_size=num_classes or default_classes, dtype=dtype,
                   seq_axis=seq_axis, model_axis=model_axis, **model_kw)
     else:

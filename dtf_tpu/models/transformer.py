@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from dtf_tpu.ops.flash_attention import flash_attention
-from dtf_tpu.parallel.collectives import tp_region
+from dtf_tpu.parallel.collectives import tp_psum, tp_region
 from dtf_tpu.parallel.ring_attention import ring_attention
 
 
@@ -80,7 +80,9 @@ class CausalSelfAttention(nn.Module):
         # (a replicated bias would be summed mp times by the psum)
         out = nn.Dense(d, dtype=self.dtype, use_bias=False, name="out")(o)
         if self.model_axis is not None:
-            out = jax.lax.psum(out, self.model_axis)
+            # g operator: sum forward, identity backward (a raw psum
+            # would scale cotangents by mp under shard_map AD)
+            out = tp_psum(out, self.model_axis)
         return out
 
 
@@ -113,7 +115,7 @@ class Block(nn.Module):
         h = nn.gelu(h)
         h = nn.Dense(d, dtype=self.dtype, use_bias=False, name="fc2")(h)  # row
         if self.model_axis is not None:
-            h = jax.lax.psum(h, self.model_axis)
+            h = tp_psum(h, self.model_axis)  # g operator (see attn)
         return x + h
 
 

@@ -78,8 +78,9 @@ def tp_region(x, axis_name: str):
     input cotangent is partial (each shard back-propagates only its
     slice of the weight); without the psum every parameter *upstream*
     of the TP region (LayerNorm, embeddings) would get wrong gradients.
-    The matching exit operator is plain `lax.psum` (sum forward,
-    identity backward — exactly the row-parallel output semantics)."""
+    The matching exit operator is `tp_psum` below (sum forward,
+    identity backward — the row-parallel output semantics).  NOT a raw
+    `lax.psum`: see tp_psum's docstring for why."""
     return x
 
 
@@ -92,6 +93,32 @@ def _tp_region_bwd(axis_name, _, g):
 
 
 tp_region.defvjp(_tp_region_fwd, _tp_region_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_psum(x, axis_name: str):
+    """Megatron's `g` operator — exit a tensor-parallel region.
+
+    Forward: all-reduce (sum) the shards' partial results.  Backward:
+    identity.  A *raw* ``lax.psum`` must not be used here: under
+    shard_map AD the transpose of psum is psum (the true transpose of
+    the joint program, in which every shard carries an identical loss
+    replica), so each raw psum on the value path multiplies the
+    upstream cotangent by the axis size — compounding per layer.  The
+    single correct gradient of *one* loss replica needs identity
+    backward, which is exactly Megatron's g."""
+    return lax.psum(x, axis_name)
+
+
+def _tp_psum_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _tp_psum_bwd(axis_name, _, g):
+    return (g,)
+
+
+tp_psum.defvjp(_tp_psum_fwd, _tp_psum_bwd)
 
 
 def broadcast_from(x, axis_name: str, root: int = 0):

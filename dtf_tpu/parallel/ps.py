@@ -400,6 +400,14 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
 
     batch = cfg.batch_size  # per-worker, like the reference's --batch_size 192
     model_name = "trivial" if cfg.use_trivial_model else cfg.model
+    if model_name.startswith(("moe_transformer", "pipeline_transformer")):
+        # the async loop applies models without the aux_loss collection
+        # and without mesh axes; routed/pipelined families need the SPMD
+        # path (and make little sense against a central param store)
+        raise ValueError(
+            f"model {model_name!r} is not supported in async "
+            "parameter-server mode; use --ps_mode sync (the SPMD "
+            "reinterpretation) for MoE/pipeline families")
     model, l2w = build_model(model_name, num_classes=spec.num_classes,
                              dtype=cfg.compute_dtype)
 

@@ -144,7 +144,9 @@ class Trainer:
         # axes (TP shards arrive by sharding the full arrays), so init
         # with an unsharded twin
         init_model = self.model
-        clone_kw = {k: None for k in ("seq_axis", "model_axis")
+        clone_kw = {k: None
+                    for k in ("seq_axis", "model_axis", "expert_axis",
+                              "pipe_axis")
                     if getattr(init_model, k, None) is not None}
         if clone_kw:
             init_model = init_model.clone(**clone_kw)
@@ -184,11 +186,18 @@ class Trainer:
         if batch_stats:
             variables["batch_stats"] = batch_stats
         if train:
+            # "aux_loss" collects regularizers sown by modules (MoE
+            # load-balance); empty for every dense model
+            mutable = (["batch_stats"] if batch_stats else []) + ["aux_loss"]
             out, mutated = self.model.apply(
-                variables, images, train=True,
-                mutable=["batch_stats"] if batch_stats else [])
+                variables, images, train=True, mutable=mutable)
             new_stats = mutated.get("batch_stats", batch_stats) if batch_stats else batch_stats
-            return out, new_stats
+            aux_leaves = jax.tree_util.tree_leaves(
+                mutated.get("aux_loss", {}))
+            aux = (jnp.sum(jnp.stack([a.astype(jnp.float32)
+                                      for a in aux_leaves]))
+                   if aux_leaves else jnp.zeros((), jnp.float32))
+            return out, new_stats, aux
         return self.model.apply(variables, images, train=False), batch_stats
 
     def _build_steps(self, state_specs=None):
@@ -205,12 +214,53 @@ class Trainer:
         loss_scale = self.loss_scale
         l2w = self.l2_weight
 
+        # Per-leaf gradient reduction.  Replicated leaves pmean over
+        # every batch-splitting axis (the NCCL-ring / collective
+        # allreduce equivalent).  Leaves *sharded over* a batch axis
+        # (MoE experts ride 'data') must not be pmean-ed there — that
+        # would average different experts' grads; reverse-mode
+        # all_to_all already summed their true grads across the group,
+        # so they are divided by the axis size to match the global-mean
+        # loss convention instead.
+        param_specs = None if state_specs is None else state_specs.params
+        mesh_shape = dict(mesh.shape)
+
+        def _spec_axes(spec):
+            axes = set()
+            for part in spec:
+                if part is None:
+                    continue
+                axes.update(part if isinstance(part, (tuple, list))
+                            else (part,))
+            return axes
+
+        def reduce_grads(grads):
+            if param_specs is None:
+                return jax.lax.pmean(grads, reduce_axes)
+
+            def red(spec, g):
+                sharded = _spec_axes(spec)
+                axes = tuple(a for a in reduce_axes if a not in sharded)
+                if axes:
+                    g = jax.lax.pmean(g, axes)
+                denom = 1
+                for a in reduce_axes:
+                    if a in sharded:
+                        denom *= mesh_shape[a]
+                if denom > 1:
+                    g = (g / denom).astype(g.dtype)
+                return g
+
+            return jax.tree_util.tree_map(
+                red, param_specs, grads,
+                is_leaf=lambda x: isinstance(x, P))
+
         def local_train_step(state: TrainState, images, labels):
             def loss_fn(params):
-                logits, new_stats = self._apply(params, state.batch_stats,
-                                                images, train=True)
+                logits, new_stats, aux = self._apply(
+                    params, state.batch_stats, images, train=True)
                 ce = cross_entropy(logits, labels)
-                loss = ce + l2_weight_penalty(params, l2w)
+                loss = ce + l2_weight_penalty(params, l2w) + aux
                 return loss * loss_scale, (loss, logits, new_stats)
 
             grads, (loss, logits, new_stats) = jax.grad(
@@ -222,7 +272,7 @@ class Trainer:
             # batch-splitting axes (≡ NCCL ring / collective allreduce /
             # PS push-pull, SURVEY §3); includes 'seq' when the sequence
             # dimension is sharded (each shard's loss covers 1/sp tokens)
-            grads = jax.lax.pmean(grads, reduce_axes)
+            grads = reduce_grads(grads)
             # per-replica BN stats averaged on update — MirroredStrategy's
             # variable aggregation semantics
             new_stats = jax.lax.pmean(new_stats, reduce_axes)
