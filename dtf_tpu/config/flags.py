@@ -53,7 +53,9 @@ class Config:
 
     # --- performance (define_performance) ---
     dtype: str = "fp32"                 # --dtype; bf16 is the TPU-native mixed mode
-    loss_scale: Optional[float] = None  # --loss_scale; only meaningful for fp16 parity
+    # --loss_scale: a number (static scale) or "dynamic" (TF2
+    # LossScaleOptimizer semantics); only meaningful for fp16 parity
+    loss_scale: Optional[Any] = None
     enable_xla: bool = True             # --enable_xla: always-on under JAX; kept as no-op shim
     all_reduce_alg: Optional[str] = None  # --all_reduce_alg (cifar_main.py:104) — advisory on TPU
     num_packs: int = 1                  # --num_packs gradient packing — XLA fuses; advisory
@@ -139,6 +141,14 @@ class Config:
         if self.optimizer not in ("sgd", "momentum", "adamw"):
             raise ValueError(
                 f"unknown optimizer {self.optimizer!r}; choose sgd or adamw")
+        if self.loss_scale is not None:
+            if str(self.loss_scale).lower() != "dynamic":
+                try:
+                    float(self.loss_scale)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"loss_scale must be a number or 'dynamic', got "
+                        f"{self.loss_scale!r}") from None
 
     # -- dtype helpers -------------------------------------------------
     @property
@@ -151,9 +161,14 @@ class Config:
         return jnp.float32
 
     @property
-    def loss_scale_value(self) -> float:
-        """Parity with flags_core.get_loss_scale: fp16 defaults to 128."""
+    def loss_scale_value(self):
+        """Parity with flags_core.get_loss_scale: fp16 defaults to a
+        static 128; ``--loss_scale dynamic`` returns the string
+        "dynamic" (TF2 LossScaleOptimizer semantics, handled by the
+        train loop)."""
         if self.loss_scale is not None:
+            if str(self.loss_scale).lower() == "dynamic":
+                return "dynamic"
             return float(self.loss_scale)
         return 128.0 if self.dtype in ("fp16", "float16") else 1.0
 

@@ -243,11 +243,11 @@ def moe_param_partition_specs(params, expert_axis: str):
     layers replicated."""
     from jax.sharding import PartitionSpec as P
 
-    def rule(path, leaf):
-        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-        last = keys[-1] if keys else ""
+    from dtf_tpu.models.partition import partition_specs
+
+    def rule(keys, last, leaf):
         if "moe" in keys and last in ("w1", "b1", "w2", "b2"):
             return P(expert_axis, *([None] * (leaf.ndim - 1)))
         return P()
 
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return partition_specs(params, rule)

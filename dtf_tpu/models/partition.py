@@ -1,0 +1,25 @@
+"""Shared scaffolding for per-family parameter partition specs.
+
+Each parallelism family (tensor, expert, pipeline) contributes only its
+match rule; the path-key extraction and tree walk live here once.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+
+def partition_specs(params, rule: Callable):
+    """Map ``rule(keys, last, leaf) -> PartitionSpec`` over a param
+    tree.  ``keys`` is the module path as strings, ``last`` its final
+    component (the param name)."""
+
+    def wrap(path, leaf):
+        keys: Sequence[str] = [getattr(p, "key", getattr(p, "name", ""))
+                               for p in path]
+        last = keys[-1] if keys else ""
+        return rule(keys, last, leaf)
+
+    return jax.tree_util.tree_map_with_path(wrap, params)

@@ -169,11 +169,11 @@ def pipeline_param_partition_specs(params, pipe_axis: str):
     over the pipeline axis; embedding/head/final-norm replicated."""
     from jax.sharding import PartitionSpec as P
 
-    def rule(path, leaf):
-        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-        last = keys[-1] if keys else ""
+    from dtf_tpu.models.partition import partition_specs
+
+    def rule(keys, last, leaf):
         if last in BLOCK_PARAMS:
             return P(pipe_axis, *([None] * (leaf.ndim - 1)))
         return P()
 
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return partition_specs(params, rule)

@@ -176,9 +176,9 @@ def param_partition_specs(params, model_axis: str):
     dim; everything else replicated."""
     from jax.sharding import PartitionSpec as P
 
-    def rule(path, leaf):
-        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
-        last = keys[-1] if keys else ""
+    from dtf_tpu.models.partition import partition_specs
+
+    def rule(keys, last, leaf):
         if "qkv" in keys:
             # kernel [d, 3, H, Dh] / bias [3, H, Dh]: shard H
             return (P(None, None, model_axis, None) if last == "kernel"
@@ -191,4 +191,4 @@ def param_partition_specs(params, model_axis: str):
             return P(model_axis, None)   # row-parallel input dim
         return P()
 
-    return jax.tree_util.tree_map_with_path(rule, params)
+    return partition_specs(params, rule)

@@ -1,0 +1,1 @@
+"""Test-support helpers (the `official.utils.testing` equivalent)."""

@@ -1,0 +1,36 @@
+"""Synthetic-data smoke harness — `integration.run_synthetic` parity.
+
+The reference's e2e tests all funnel through
+``official.utils.testing.integration.run_synthetic(main, extra_flags)``
+(reference resnet_cifar_test.py:73-77, SURVEY.md §3.6): parse the extra
+flags, force the synthetic data backend, invoke the real ``run()``, and
+treat "did not crash" as the assertion.  This module provides the same
+contract against our flag system so downstream users' test suites port
+directly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from dtf_tpu.config import Config, parse_flags
+
+
+def run_synthetic(main: Callable[[Config], dict],
+                  extra_flags: Optional[Sequence[str]] = None,
+                  synth: bool = True,
+                  defaults: Optional[dict] = None) -> dict:
+    """Run ``main`` (a ``run(cfg) -> stats`` callable) with synthetic
+    data and the given extra CLI flags; returns the stats dict.
+
+    Mirrors the reference helper: `-use_synthetic_data true` is forced
+    (unless ``synth=False``), and checkpointing is disabled so smoke
+    cells leave nothing behind.
+    """
+    argv = list(extra_flags or [])
+    if synth:
+        argv += ["--use_synthetic_data", "true"]
+    base = dict(skip_checkpoint=True, model_dir="")
+    base.update(defaults or {})
+    cfg = parse_flags(argv, defaults=base)
+    return main(cfg)

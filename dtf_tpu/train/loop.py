@@ -38,7 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dtf_tpu.config import Config
 from dtf_tpu.data.base import DatasetSpec
 from dtf_tpu.models.registry import l2_weight_penalty
-from dtf_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS, MeshRuntime
+from dtf_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
+                                  MeshRuntime)
 from dtf_tpu.train import schedules as sched_lib
 from dtf_tpu.train.optimizer import build_optimizer
 from dtf_tpu.utils.logs import TimeHistory, build_stats
@@ -52,6 +53,17 @@ class TrainState:
     params: Any
     batch_stats: Any
     opt_state: Any
+    # dynamic loss scaling only (--loss_scale dynamic): the live scale
+    # and the count of consecutive finite steps; None under static
+    # scaling (None is an empty pytree — costs nothing)
+    loss_scale: Any = None
+    good_steps: Any = None
+
+
+# TF2 LossScaleOptimizer dynamic defaults (reference
+# resnet_imagenet_main.py:182-187 wraps the optimizer in one)
+DYNAMIC_SCALE_INIT = 2.0 ** 15
+DYNAMIC_GROWTH_INTERVAL = 2000
 
 
 def cross_entropy(logits, labels):
@@ -125,7 +137,9 @@ class Trainer:
                 spec.num_train, use_tensor_lr=cfg.use_tensor_lr,
                 train_epochs=self.train_epochs)
         self.tx = build_optimizer(cfg.optimizer, self.schedule)
-        self.loss_scale = cfg.loss_scale_value
+        self.dynamic_scale = cfg.loss_scale_value == "dynamic"
+        self.loss_scale = (1.0 if self.dynamic_scale
+                           else float(cfg.loss_scale_value))
 
         if self.param_spec_fn is None:
             self._build_steps()
@@ -155,8 +169,13 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         opt_state = self.tx.init(params)
-        state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                           batch_stats=batch_stats, opt_state=opt_state)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            batch_stats=batch_stats, opt_state=opt_state,
+            loss_scale=(jnp.float32(DYNAMIC_SCALE_INIT)
+                        if self.dynamic_scale else None),
+            good_steps=(jnp.zeros((), jnp.int32)
+                        if self.dynamic_scale else None))
         if self.param_spec_fn is None:
             # replicate across the mesh
             return jax.device_put(state, self.rt.replicated())
@@ -178,7 +197,9 @@ class Trainer:
             params=pspecs,
             batch_stats=jax.tree_util.tree_map(lambda _: rep,
                                                state.batch_stats),
-            opt_state=opt_state_specs(self.cfg.optimizer, pspecs, rep))
+            opt_state=opt_state_specs(self.cfg.optimizer, pspecs, rep),
+            loss_scale=rep if self.dynamic_scale else None,
+            good_steps=rep if self.dynamic_scale else None)
 
     # ------------------------------------------------------------------
     def _apply(self, params, batch_stats, images, train):
@@ -255,19 +276,22 @@ class Trainer:
                 red, param_specs, grads,
                 is_leaf=lambda x: isinstance(x, P))
 
+        dynamic = self.dynamic_scale
+
         def local_train_step(state: TrainState, images, labels):
+            scale = state.loss_scale if dynamic else loss_scale
+
             def loss_fn(params):
                 logits, new_stats, aux = self._apply(
                     params, state.batch_stats, images, train=True)
                 ce = cross_entropy(logits, labels)
                 loss = ce + l2_weight_penalty(params, l2w) + aux
-                return loss * loss_scale, (loss, logits, new_stats)
+                return loss * scale, (loss, logits, new_stats)
 
             grads, (loss, logits, new_stats) = jax.grad(
                 loss_fn, has_aux=True)(state.params)
-            if loss_scale != 1.0:
-                grads = jax.tree_util.tree_map(
-                    lambda g: g / loss_scale, grads)
+            if dynamic or loss_scale != 1.0:
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
             # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
             # batch-splitting axes (≡ NCCL ring / collective allreduce /
             # PS push-pull, SURVEY §3); includes 'seq' when the sequence
@@ -280,14 +304,48 @@ class Trainer:
             updates, new_opt = self.tx.update(
                 grads, state.opt_state, state.params, step=state.step)
             params = optax.apply_updates(state.params, updates)
+            new_scale, new_good = state.loss_scale, state.good_steps
+            if dynamic:
+                # TF2 LossScaleOptimizer semantics: skip the update on
+                # non-finite grads and halve the scale; double it after
+                # DYNAMIC_GROWTH_INTERVAL consecutive finite steps
+                finite = jnp.array(True)
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+                # every shard must reach the same verdict: a leaf
+                # sharded over an axis (experts over 'data', TP/PP
+                # stacks over 'model') can overflow on one shard only,
+                # and a split decision would silently desynchronize
+                # the replicated leaves and the scale itself
+                finite = jax.lax.pmin(
+                    finite.astype(jnp.int32),
+                    (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)).astype(bool)
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new, old)
+                params = keep(params, state.params)
+                new_opt = keep(new_opt, state.opt_state)
+                new_stats = keep(new_stats, state.batch_stats)
+                grew = state.good_steps + 1 >= DYNAMIC_GROWTH_INTERVAL
+                new_scale = jnp.where(
+                    finite,
+                    jnp.where(grew, scale * 2.0, scale),
+                    jnp.maximum(scale * 0.5, 1.0))
+                new_good = jnp.where(jnp.logical_and(finite,
+                                                     jnp.logical_not(grew)),
+                                     state.good_steps + 1, 0)
             acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
             metrics = {
                 "loss": jax.lax.pmean(loss, reduce_axes),
                 "accuracy": jax.lax.pmean(acc, reduce_axes),
                 "learning_rate": self.schedule(state.step),
             }
+            if dynamic:
+                metrics["loss_scale"] = new_scale
             return TrainState(step=state.step + 1, params=params,
-                              batch_stats=new_stats, opt_state=new_opt), metrics
+                              batch_stats=new_stats, opt_state=new_opt,
+                              loss_scale=new_scale,
+                              good_steps=new_good), metrics
 
         def local_eval_step(state: TrainState, images, labels):
             logits, _ = self._apply(state.params, state.batch_stats,

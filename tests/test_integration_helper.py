@@ -1,0 +1,38 @@
+"""`testing.integration.run_synthetic` — the reference's e2e smoke
+harness contract (integration.run_synthetic, SURVEY §3.6): extra flags
+in, synthetic data forced, real run() invoked, stats out."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import dtf_tpu.data.base as data_base
+from dtf_tpu.cli.runner import run
+from dtf_tpu.testing.integration import run_synthetic
+
+TINY = dataclasses.replace(data_base.CIFAR10, image_size=8, num_train=64,
+                           num_eval=16)
+
+
+@pytest.fixture(autouse=True)
+def tiny_specs(monkeypatch):
+    monkeypatch.setitem(data_base._SPECS, "cifar10", TINY)
+
+
+def test_run_synthetic_smoke():
+    """The reference's own smoke invocation shape:
+    -train_steps 1 -batch_size 4 -use_synthetic_data true."""
+    stats = run_synthetic(run, [
+        "--model", "resnet20", "--dataset", "cifar10",
+        "--train_steps", "1", "--batch_size", "4",
+        "--skip_eval", "--distribution_strategy", "off"])
+    assert np.isfinite(stats["loss"])
+
+
+def test_run_synthetic_defaults_override():
+    stats = run_synthetic(
+        run, ["--train_steps", "1", "--batch_size", "4", "--skip_eval"],
+        defaults=dict(model="trivial", dataset="cifar10", num_classes=10,
+                      distribution_strategy="off"))
+    assert np.isfinite(stats["loss"])

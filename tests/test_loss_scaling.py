@@ -1,0 +1,95 @@
+"""Dynamic loss scaling (--loss_scale dynamic): TF2 LossScaleOptimizer
+semantics — skip-and-halve on non-finite grads, double after the growth
+interval of consecutive finite steps (fp16 parity, reference
+resnet_imagenet_main.py:182-187)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dtf_tpu.data.base as data_base
+from dtf_tpu.cli import run
+from dtf_tpu.config import Config
+from dtf_tpu.models import build_model
+from dtf_tpu.runtime import initialize
+from dtf_tpu.train import Trainer
+from dtf_tpu.train.loop import DYNAMIC_SCALE_INIT
+
+TINY = dataclasses.replace(data_base.CIFAR10, image_size=8, num_train=64,
+                           num_eval=16)
+
+
+@pytest.fixture(autouse=True)
+def tiny_specs(monkeypatch):
+    monkeypatch.setitem(data_base._SPECS, "cifar10", TINY)
+
+
+def test_loss_scale_flag_accepts_dynamic():
+    assert Config(dtype="fp16", loss_scale="dynamic").loss_scale_value == "dynamic"
+    assert Config(dtype="fp16", loss_scale=256).loss_scale_value == 256.0
+    with pytest.raises(ValueError):
+        Config(loss_scale="huge")
+
+
+def _make_trainer(**cfg_kw):
+    cfg = Config(model="trivial", dataset="cifar10", batch_size=8,
+                 train_steps=2, use_synthetic_data=True, skip_eval=True,
+                 log_steps=1, distribution_strategy="off", dtype="fp16",
+                 loss_scale="dynamic", num_classes=10, **cfg_kw)
+    rt = initialize(cfg)
+    spec = dataclasses.replace(TINY, num_classes=10)
+    model, l2 = build_model("trivial", num_classes=10,
+                            dtype=cfg.compute_dtype)
+    return cfg, rt, Trainer(cfg, rt, model, l2, spec)
+
+
+def test_dynamic_scale_halves_and_skips_on_overflow():
+    _, rt, trainer = _make_trainer()
+    good = np.random.default_rng(0).normal(size=(8, 8, 8, 3)).astype(np.float32)
+    labels = np.zeros((8,), np.int32)
+    state = trainer.init_state(jax.random.key(0), (good, labels))
+    assert float(state.loss_scale) == DYNAMIC_SCALE_INIT
+    params_before = jax.device_get(state.params)
+
+    # fp16 forward overflows → non-finite grads → update skipped
+    bad = np.full((8, 8, 8, 3), 1e30, np.float32)
+    state2, metrics = trainer.train_step(state, *rt.shard_batch((bad, labels)))
+    assert float(state2.loss_scale) == DYNAMIC_SCALE_INIT / 2
+    assert int(state2.good_steps) == 0
+    for a, b in zip(jax.tree_util.tree_leaves(params_before),
+                    jax.tree_util.tree_leaves(jax.device_get(state2.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # a finite step still applies the update and counts toward growth
+    state3, _ = trainer.train_step(state2, *rt.shard_batch((good, labels)))
+    assert float(state3.loss_scale) == DYNAMIC_SCALE_INIT / 2
+    assert int(state3.good_steps) == 1
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(params_before),
+                        jax.tree_util.tree_leaves(jax.device_get(state3.params))))
+    assert changed
+
+
+def test_dynamic_scale_doubles_after_growth_interval():
+    _, rt, trainer = _make_trainer()
+    good = np.random.default_rng(1).normal(size=(8, 8, 8, 3)).astype(np.float32)
+    labels = np.zeros((8,), np.int32)
+    state = trainer.init_state(jax.random.key(0), (good, labels))
+    state = dataclasses.replace(state, good_steps=jnp.int32(1999))
+    state2, metrics = trainer.train_step(state, *rt.shard_batch((good, labels)))
+    assert float(state2.loss_scale) == DYNAMIC_SCALE_INIT * 2
+    assert int(state2.good_steps) == 0
+    assert float(metrics["loss_scale"]) == DYNAMIC_SCALE_INIT * 2
+
+
+def test_dynamic_scale_e2e_cli():
+    stats = run(Config(model="resnet20", dataset="cifar10", batch_size=8,
+                       train_steps=2, use_synthetic_data=True,
+                       skip_eval=True, skip_checkpoint=True, model_dir="",
+                       log_steps=1, distribution_strategy="off",
+                       dtype="fp16", loss_scale="dynamic"))
+    assert np.isfinite(stats["loss"])
