@@ -137,6 +137,13 @@ def run(cfg: Config) -> dict:
             aux_weight=cfg.moe_aux_weight).items() if v is not None}
     elif is_pipeline and cfg.num_microbatches is not None:
         model_kw = dict(num_microbatches=cfg.num_microbatches)
+    shard_vocab = bool(cfg.shard_lm_head and model_axis is not None)
+    if cfg.shard_lm_head and model_axis is None:
+        raise ValueError(
+            "--shard_lm_head needs the plain transformer family with "
+            "--model_parallelism > 1")
+    if shard_vocab:
+        model_kw = dict(model_kw, shard_vocab=True)
     model, l2 = build_model(
         model_name, num_classes=spec.num_classes, dtype=cfg.compute_dtype,
         bn_axis=DATA_AXIS if cfg.sync_bn else None, seq_axis=seq_axis,
@@ -148,7 +155,8 @@ def run(cfg: Config) -> dict:
     if model_axis is not None:
         from dtf_tpu.models.transformer import param_partition_specs
         param_spec_fn = functools.partial(param_partition_specs,
-                                          model_axis=model_axis)
+                                          model_axis=model_axis,
+                                          shard_vocab=shard_vocab)
     elif is_moe:
         from dtf_tpu.models.moe import moe_param_partition_specs
         param_spec_fn = functools.partial(moe_param_partition_specs,
@@ -157,7 +165,8 @@ def run(cfg: Config) -> dict:
         from dtf_tpu.models.pipeline_lm import pipeline_param_partition_specs
         param_spec_fn = functools.partial(pipeline_param_partition_specs,
                                           pipe_axis=pipe_axis)
-    trainer = Trainer(cfg, rt, model, l2, spec, param_spec_fn=param_spec_fn)
+    trainer = Trainer(cfg, rt, model, l2, spec, param_spec_fn=param_spec_fn,
+                      vocab_axis=MODEL_AXIS if shard_vocab else None)
     train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)
 
     train_iter = train_fn()

@@ -109,6 +109,9 @@ class Config:
     # keep model/seq axes open even though the reference is DP-only)
     model_parallelism: int = 1          # size of the 'model' mesh axis
     seq_parallelism: int = 1            # size of the 'seq' mesh axis (ring attention)
+    # column-parallel lm_head over 'model' (Megatron vocab-parallel
+    # softmax): local logits + collective CE; transformer family only
+    shard_lm_head: bool = False
     sync_bn: bool = False               # cross-replica BN (reference default: per-replica)
 
     # --- mixture-of-experts (moe_transformer family) ---
@@ -144,11 +147,16 @@ class Config:
         if self.loss_scale is not None:
             if str(self.loss_scale).lower() != "dynamic":
                 try:
-                    float(self.loss_scale)
+                    val = float(self.loss_scale)
                 except (TypeError, ValueError):
                     raise ValueError(
                         f"loss_scale must be a number or 'dynamic', got "
                         f"{self.loss_scale!r}") from None
+                import math
+                if not math.isfinite(val) or val <= 0:
+                    raise ValueError(
+                        f"loss_scale must be a positive finite number, "
+                        f"got {val}")
 
     # -- dtype helpers -------------------------------------------------
     @property

@@ -133,6 +133,12 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     model_axis: Optional[str] = None
+    # column-parallel lm_head over `model_axis`: this module then
+    # returns LOCAL logits [B, S, V/mp] and the loss must be the
+    # collective softmax CE (train.loop.sharded_cross_entropy) — the
+    # full [B, S, V] logits never materialize (Megatron's
+    # vocab-parallel output layer)
+    shard_vocab: bool = False
     use_pallas: Any = None
     remat: bool = False
 
@@ -161,18 +167,28 @@ class TransformerLM(nn.Module):
                       seq_axis=self.seq_axis, model_axis=self.model_axis,
                       use_pallas=self.use_pallas, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        # lm_head stays replicated (vocab-sharding the head would shard
-        # the logits and the CE loss — a further optimization, not a
-        # capability)
-        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
-                          name="lm_head")(x)
+        vocab = self.vocab_size
+        if self.shard_vocab and self.model_axis is not None:
+            mp = jax.lax.psum(1, self.model_axis)
+            if vocab % mp:
+                raise ValueError(
+                    f"vocab_size {vocab} not divisible by "
+                    f"model_parallelism {mp}")
+            vocab //= mp
+            # x is fully replicated here (the last block exited through
+            # tp_psum) but its cotangent arrives vocab-shard-partial —
+            # the f operator restores the full upstream gradient
+            x = tp_region(x, self.model_axis)
+        logits = nn.Dense(vocab, dtype=self.dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)
 
 
-def param_partition_specs(params, model_axis: str):
+def param_partition_specs(params, model_axis: str,
+                          shard_vocab: bool = False):
     """PartitionSpec tree sharding a full TransformerLM param tree onto
     the tensor-parallel axis: qkv kernel/bias on the head dim, fc1
     kernel/bias on the ff dim, out/fc2 kernels on their input (row)
+    dim, and (with ``shard_vocab``) the lm_head on its vocab (column)
     dim; everything else replicated."""
     from jax.sharding import PartitionSpec as P
 
@@ -189,6 +205,10 @@ def param_partition_specs(params, model_axis: str):
                     else P(model_axis))
         if ("out" in keys or "fc2" in keys) and last == "kernel":
             return P(model_axis, None)   # row-parallel input dim
+        if shard_vocab and "lm_head" in keys:
+            # kernel [d, V] / bias [V]: shard V (column-parallel)
+            return (P(None, model_axis) if last == "kernel"
+                    else P(model_axis))
         return P()
 
     return partition_specs(params, rule)

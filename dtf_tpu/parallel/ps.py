@@ -408,6 +408,13 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
             f"model {model_name!r} is not supported in async "
             "parameter-server mode; use --ps_mode sync (the SPMD "
             "reinterpretation) for MoE/pipeline families")
+    if cfg.shard_lm_head or cfg.model_parallelism > 1 or cfg.seq_parallelism > 1:
+        # no mesh in the async loop — a silently-dense head or an unused
+        # parallel axis would contradict what the flags promise
+        raise ValueError(
+            "--shard_lm_head/--model_parallelism/--seq_parallelism need "
+            "the SPMD path; async parameter-server workers are "
+            "single-device")
     model, l2w = build_model(model_name, num_classes=spec.num_classes,
                              dtype=cfg.compute_dtype)
 

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -73,18 +74,60 @@ def cross_entropy(logits, labels):
         optax.softmax_cross_entropy_with_integer_labels(logits, labels))
 
 
+def sharded_cross_entropy(local_logits, labels, axis: str):
+    """Mean CE over vocab-sharded logits (Megatron's vocab-parallel
+    softmax): a collective logsumexp over the model axis — the full
+    vocab dimension never materializes on one shard.
+
+    The two reductions are g-operator psums (`tp_psum`: sum forward,
+    identity backward), which yields exactly the gradient of one loss
+    replica; the max is stop-gradiented (it cancels analytically)."""
+    from dtf_tpu.parallel.collectives import tp_psum
+
+    vloc = local_logits.shape[-1]
+    offset = lax.axis_index(axis) * vloc
+    # stop_gradient *before* pmax: pmax has no differentiation rule,
+    # and the max shift cancels analytically in the CE gradient anyway
+    m = lax.pmax(jnp.max(lax.stop_gradient(local_logits), -1), axis)
+    sumexp = tp_psum(
+        jnp.sum(jnp.exp(local_logits - m[..., None]), -1), axis)
+    lse = jnp.log(sumexp) + m
+    local_label = labels - offset
+    in_range = jnp.logical_and(local_label >= 0, local_label < vloc)
+    safe = jnp.clip(local_label, 0, vloc - 1)
+    picked = jnp.take_along_axis(local_logits, safe[..., None], -1)[..., 0]
+    correct = tp_psum(jnp.where(in_range, picked, 0.0), axis)
+    return jnp.mean(lse - correct)
+
+
+def sharded_argmax(local_logits, axis: str):
+    """Global argmax over vocab-sharded logits (metrics only — not
+    differentiated).  Ties resolve to the highest global index."""
+    vloc = local_logits.shape[-1]
+    offset = lax.axis_index(axis) * vloc
+    local_max = jnp.max(local_logits, -1)
+    local_arg = jnp.argmax(local_logits, -1) + offset
+    best = lax.pmax(local_max, axis)
+    cand = jnp.where(local_max == best, local_arg, -1)
+    return lax.pmax(cand, axis)
+
+
 class Trainer:
     """Builds jitted SPMD train/eval steps and runs the fit loop."""
 
     def __init__(self, cfg: Config, runtime: MeshRuntime, model,
                  l2_weight: float, spec: DatasetSpec,
                  schedule: Optional[Callable] = None,
-                 param_spec_fn: Optional[Callable] = None):
+                 param_spec_fn: Optional[Callable] = None,
+                 vocab_axis: Optional[str] = None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
         self.l2_weight = l2_weight
         self.spec = spec
+        # vocab-sharded lm_head: logits arrive [B, S, V/mp] and the
+        # loss/metrics go through the collective softmax forms
+        self.vocab_axis = vocab_axis
         # tensor parallelism: fn(params) -> PartitionSpec tree sharding
         # params over the 'model' axis (e.g. transformer.
         # param_partition_specs).  The L2 penalty sums over param leaves
@@ -277,6 +320,19 @@ class Trainer:
                 is_leaf=lambda x: isinstance(x, P))
 
         dynamic = self.dynamic_scale
+        vocab_axis = self.vocab_axis
+
+        def compute_ce(logits, labels):
+            if vocab_axis is not None:
+                return sharded_cross_entropy(logits, labels, vocab_axis)
+            return cross_entropy(logits, labels)
+
+        def compute_acc(logits, labels):
+            if vocab_axis is not None:
+                preds = sharded_argmax(logits, vocab_axis)
+            else:
+                preds = jnp.argmax(logits, -1)
+            return jnp.mean((preds == labels).astype(jnp.float32))
 
         def local_train_step(state: TrainState, images, labels):
             scale = state.loss_scale if dynamic else loss_scale
@@ -284,7 +340,7 @@ class Trainer:
             def loss_fn(params):
                 logits, new_stats, aux = self._apply(
                     params, state.batch_stats, images, train=True)
-                ce = cross_entropy(logits, labels)
+                ce = compute_ce(logits, labels)
                 loss = ce + l2_weight_penalty(params, l2w) + aux
                 return loss * scale, (loss, logits, new_stats)
 
@@ -334,7 +390,7 @@ class Trainer:
                 new_good = jnp.where(jnp.logical_and(finite,
                                                      jnp.logical_not(grew)),
                                      state.good_steps + 1, 0)
-            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            acc = compute_acc(logits, labels)
             metrics = {
                 "loss": jax.lax.pmean(loss, reduce_axes),
                 "accuracy": jax.lax.pmean(acc, reduce_axes),
@@ -350,8 +406,8 @@ class Trainer:
         def local_eval_step(state: TrainState, images, labels):
             logits, _ = self._apply(state.params, state.batch_stats,
                                     images, train=False)
-            loss = cross_entropy(logits, labels)
-            acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            loss = compute_ce(logits, labels)
+            acc = compute_acc(logits, labels)
             return (jax.lax.pmean(loss, reduce_axes),
                     jax.lax.pmean(acc, reduce_axes))
 
