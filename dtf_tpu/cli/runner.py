@@ -137,6 +137,13 @@ def run(cfg: Config) -> dict:
             aux_weight=cfg.moe_aux_weight).items() if v is not None}
     elif is_pipeline and cfg.num_microbatches is not None:
         model_kw = dict(num_microbatches=cfg.num_microbatches)
+    if cfg.remat:
+        if not model_name.startswith(
+                ("transformer", "moe_transformer", "pipeline_transformer")):
+            raise ValueError(
+                f"--remat is implemented for the transformer families, "
+                f"not {model_name!r}")
+        model_kw = dict(model_kw, remat=True)
     shard_vocab = bool(cfg.shard_lm_head and model_axis is not None)
     if cfg.shard_lm_head and model_axis is None:
         raise ValueError(

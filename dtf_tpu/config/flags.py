@@ -126,6 +126,13 @@ class Config:
     # --- optimizer ---
     optimizer: str = "sgd"              # sgd (reference, common.py:169-172)
                                         # | adamw (transformer LM recipe)
+    # gradient accumulation: each step runs this many sequential
+    # microbatch fwd/bwd passes per replica before one update — trains
+    # reference-scale global batches on fewer chips
+    grad_accum_steps: int = 1
+    # rematerialization (jax.checkpoint) around each transformer block:
+    # trade recompute FLOPs for HBM — the long-context memory lever
+    remat: bool = False
 
     # --- misc ---
     seed: int = 0

@@ -74,6 +74,7 @@ class PipelinedTransformerLM(nn.Module):
     dtype: Any = jnp.float32
     pipe_axis: Optional[str] = None
     use_pallas: Any = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -136,11 +137,12 @@ class PipelinedTransformerLM(nn.Module):
             return h + (f @ p["fc2_k"].astype(dtype)
                         + p["fc2_b"].astype(dtype))
 
+        step = (jax.checkpoint(block_step) if self.remat else block_step)
+
         def stage_fn(h):
             # scan over this shard's block stack (leading dim of the
             # received params — full depth off-mesh, depth/pp on it)
-            h, _ = lax.scan(lambda c, p: (block_step(c, p), None),
-                            h, blocks)
+            h, _ = lax.scan(lambda c, p: (step(c, p), None), h, blocks)
             return h
 
         x = embed[tokens].astype(dtype) + pos[:s].astype(dtype)

@@ -103,6 +103,9 @@ def sharded_cross_entropy(local_logits, labels, axis: str):
 def sharded_argmax(local_logits, axis: str):
     """Global argmax over vocab-sharded logits (metrics only — not
     differentiated).  Ties resolve to the highest global index."""
+    # callers may sit inside a differentiated function (train-step
+    # metrics) and pmax has no differentiation rule
+    local_logits = lax.stop_gradient(local_logits)
     vloc = local_logits.shape[-1]
     offset = lax.axis_index(axis) * vloc
     local_max = jnp.max(local_logits, -1)
@@ -149,6 +152,12 @@ class Trainer:
                 f"the number of data-parallel replicas "
                 f"({runtime.num_replicas}); pick a batch size that is a "
                 f"multiple, or reduce --num_devices")
+        self.grad_accum = max(int(cfg.grad_accum_steps or 1), 1)
+        if (self.global_batch // runtime.num_replicas) % self.grad_accum:
+            raise ValueError(
+                f"per-replica batch "
+                f"{self.global_batch // runtime.num_replicas} must be "
+                f"divisible by grad_accum_steps ({self.grad_accum})")
         if spec.is_sequence:
             sp = runtime.mesh.shape[SEQ_AXIS]
             if spec.seq_len % sp:
@@ -334,18 +343,50 @@ class Trainer:
                 preds = jnp.argmax(logits, -1)
             return jnp.mean((preds == labels).astype(jnp.float32))
 
+        accum = self.grad_accum
+
         def local_train_step(state: TrainState, images, labels):
             scale = state.loss_scale if dynamic else loss_scale
 
-            def loss_fn(params):
-                logits, new_stats, aux = self._apply(
-                    params, state.batch_stats, images, train=True)
-                ce = compute_ce(logits, labels)
-                loss = ce + l2_weight_penalty(params, l2w) + aux
-                return loss * scale, (loss, logits, new_stats)
+            def grad_of_chunk(params, batch_stats, imgs, lbls):
+                def loss_fn(p):
+                    logits, new_stats, aux = self._apply(
+                        p, batch_stats, imgs, train=True)
+                    ce = compute_ce(logits, lbls)
+                    loss = ce + l2_weight_penalty(p, l2w) + aux
+                    return loss * scale, (loss, compute_acc(logits, lbls),
+                                          new_stats)
+                return jax.grad(loss_fn, has_aux=True)(params)
 
-            grads, (loss, logits, new_stats) = jax.grad(
-                loss_fn, has_aux=True)(state.params)
+            if accum == 1:
+                grads, (loss, acc, new_stats) = grad_of_chunk(
+                    state.params, state.batch_stats, images, labels)
+            else:
+                # sequential microbatches: grads accumulate in the scan
+                # carry (one buffer, not A stacked copies); BN stats
+                # thread through exactly as A consecutive steps would
+                chunks = jax.tree_util.tree_map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum)
+                                        + x.shape[1:]), (images, labels))
+
+                def body(carry, chunk):
+                    gacc, stats, lacc, aacc = carry
+                    g, (l, a, stats) = grad_of_chunk(
+                        state.params, stats, *chunk)
+                    gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
+                    return (gacc, stats, lacc + l, aacc + a), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.promote_types(
+                        p.dtype, jnp.float32)), state.params)
+                (gsum, new_stats, lsum, asum), _ = lax.scan(
+                    body, (zeros, state.batch_stats,
+                           jnp.zeros((), jnp.float32),
+                           jnp.zeros((), jnp.float32)), chunks)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / accum).astype(p.dtype),
+                    gsum, state.params)
+                loss, acc = lsum / accum, asum / accum
             if dynamic or loss_scale != 1.0:
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
             # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
@@ -390,7 +431,6 @@ class Trainer:
                 new_good = jnp.where(jnp.logical_and(finite,
                                                      jnp.logical_not(grew)),
                                      state.good_steps + 1, 0)
-            acc = compute_acc(logits, labels)
             metrics = {
                 "loss": jax.lax.pmean(loss, reduce_axes),
                 "accuracy": jax.lax.pmean(acc, reduce_axes),
