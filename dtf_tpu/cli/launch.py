@@ -50,36 +50,65 @@ def build_env(rank: int, world: int, coordinator: str,
     return env
 
 
-def launch_local(cmd: List[str], num_processes: int, coordinator: str,
-                 log_dir: str, devices_per_process: Optional[int],
-                 stagger_s: float = 0.0) -> int:
+def _run_once(cmd: List[str], num_processes: int, coordinator: str,
+              log_dir: str, devices_per_process: Optional[int],
+              stagger_s: float = 0.0,
+              heartbeat_timeout: Optional[float] = None,
+              attempt: int = 0) -> int:
     os.makedirs(log_dir, exist_ok=True)
     procs = []  # (rank, Popen)
     logs = []
     rc = 0
+    # hang watchdog state: last time each rank's log grew
+    sizes = [0] * num_processes
+    last_beat = [0.0] * num_processes
+    # restart attempts keep earlier logs (the first failure is usually
+    # the informative one): log0.log, then log0.retry1.log, ...
+    suffix = f".retry{attempt}" if attempt else ""
+    log_path = lambda rank: os.path.join(log_dir, f"log{rank}{suffix}.log")
     try:
         for rank in range(num_processes):
-            log_path = os.path.join(log_dir, f"log{rank}.log")
-            f = open(log_path, "wb")
+            f = open(log_path(rank), "wb")
             logs.append(f)
             p = subprocess.Popen(
                 cmd, env=build_env(rank, num_processes, coordinator,
                                    devices_per_process),
                 stdout=f, stderr=subprocess.STDOUT)
             procs.append((rank, p))
+            last_beat[rank] = time.monotonic()  # budget starts at spawn
             if stagger_s:
                 time.sleep(stagger_s)  # run.sh's 1 s stagger, now optional
         while procs:
             for rank, p in list(procs):
                 ret = p.poll()
                 if ret is None:
+                    if heartbeat_timeout:
+                        # liveness = the rank's log keeps growing (every
+                        # rank emits BenchmarkMetric lines at
+                        # --log_steps cadence); a stalled log past the
+                        # timeout means a hung collective or deadlock —
+                        # the failure mode the reference could only
+                        # resolve by hand with kill.sh
+                        try:
+                            sz = os.path.getsize(log_path(rank))
+                        except OSError:
+                            sz = sizes[rank]
+                        now = time.monotonic()
+                        if sz != sizes[rank]:
+                            sizes[rank] = sz
+                            last_beat[rank] = now
+                        elif now - last_beat[rank] > heartbeat_timeout:
+                            print(f"rank {rank} heartbeat lost "
+                                  f"({heartbeat_timeout:.0f}s without log "
+                                  f"output); killing", file=sys.stderr)
+                            p.kill()
                     continue
                 procs.remove((rank, p))
                 if ret != 0:
                     if rc == 0:  # keep the FIRST failure's code
                         rc = ret
                     print(f"rank {rank} exited {ret} (see "
-                          f"{log_dir}/log{rank}.log); tearing down",
+                          f"{log_path(rank)}); tearing down",
                           file=sys.stderr)
                     for _, q in procs:  # kill.sh parity
                         q.send_signal(signal.SIGTERM)
@@ -90,6 +119,31 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
         for f in logs:
             f.close()
     return rc
+
+
+def launch_local(cmd: List[str], num_processes: int, coordinator: str,
+                 log_dir: str, devices_per_process: Optional[int],
+                 stagger_s: float = 0.0, max_restarts: int = 0,
+                 heartbeat_timeout: Optional[float] = None) -> int:
+    """Run the job, optionally supervising it.
+
+    ``max_restarts``: on any rank failing (or hanging, with
+    ``heartbeat_timeout``), tear down and relaunch ALL ranks — the
+    sync-SPMD recovery unit is the whole job, with progress carried by
+    checkpoints (pair the training command with ``--resume``).  The
+    reference's recovery story was manual: per-epoch checkpoints plus
+    an operator running kill.sh and re-running run.sh (SURVEY §5.3).
+    """
+    attempt = 0
+    while True:
+        rc = _run_once(cmd, num_processes, coordinator, log_dir,
+                       devices_per_process, stagger_s, heartbeat_timeout,
+                       attempt=attempt)
+        if rc == 0 or attempt >= max_restarts:
+            return rc
+        attempt += 1
+        print(f"relaunching all {num_processes} ranks (restart "
+              f"{attempt}/{max_restarts})", file=sys.stderr)
 
 
 def cluster_commands(cmd: List[str], hosts: List[str], coordinator: str,
@@ -127,6 +181,8 @@ def main(argv=None) -> int:
     log_dir = "./ranklogs"
     devices_per_process: Optional[int] = None
     execute = False
+    max_restarts = 0
+    heartbeat_timeout: Optional[float] = None
     i = 0
     while i < len(opts):
         o = opts[i]
@@ -143,6 +199,10 @@ def main(argv=None) -> int:
             devices_per_process = int(opts[i + 1]); i += 2
         elif o == "--execute":
             execute = True; i += 1
+        elif o == "--max_restarts":
+            max_restarts = int(opts[i + 1]); i += 2
+        elif o == "--heartbeat_timeout":
+            heartbeat_timeout = float(opts[i + 1]); i += 2
         else:
             raise ValueError(f"unknown launcher option {o}")
 
@@ -151,6 +211,10 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--hosts runs one rank per host; --num_processes/"
                 "--devices_per_process are not supported with it")
+        if max_restarts or heartbeat_timeout:
+            raise ValueError(
+                "--max_restarts/--heartbeat_timeout supervise local "
+                "fan-out; for --hosts runs, supervise on each host")
         if coordinator == "localhost:12346":
             coordinator = f"{hosts[0]}:12346"
         lines = cluster_commands(cmd, hosts, coordinator, log_dir,
@@ -169,7 +233,8 @@ def main(argv=None) -> int:
                     rc = ret
         return rc
     return launch_local(cmd, num_processes, coordinator, log_dir,
-                        devices_per_process)
+                        devices_per_process, max_restarts=max_restarts,
+                        heartbeat_timeout=heartbeat_timeout)
 
 
 if __name__ == "__main__":
