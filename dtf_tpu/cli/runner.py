@@ -179,8 +179,6 @@ def run(cfg: Config) -> dict:
     train_iter = train_fn()
     first = next(train_iter)
     state = trainer.init_state(jax.random.key(cfg.seed), first)
-    prefetched = DevicePrefetcher(itertools.chain([first], train_iter), rt,
-                                  buffer_size=2)
 
     callbacks = []
     ckpt_mod = None
@@ -216,6 +214,18 @@ def run(cfg: Config) -> dict:
     if cfg.enable_tensorboard and cfg.model_dir and is_coordinator():
         from dtf_tpu.utils.tensorboard import TensorBoardCallback
         callbacks.append(TensorBoardCallback(cfg.model_dir))
+
+    if cfg.eval_only:
+        # before the prefetcher: no training batches are consumed, so
+        # no background transfer thread should start
+        from dtf_tpu.utils.logs import build_stats
+        eval_output = trainer.evaluate(state, eval_fn())
+        stats = build_stats({}, eval_output, None)
+        log.info("Run stats (eval only): %s", stats)
+        return stats
+
+    prefetched = DevicePrefetcher(itertools.chain([first], train_iter), rt,
+                                  buffer_size=2)
 
     # logger.benchmark_context parity (resnet_cifar_main.py:234)
     from dtf_tpu.utils.benchmark_logger import benchmark_context

@@ -73,6 +73,7 @@ class Config:
     # --- keras-flags extras (common.py:248-309) ---
     enable_eager: bool = False          # no-op: JAX is eager outside jit by construction
     skip_eval: bool = False             # --skip_eval
+    eval_only: bool = False             # evaluate (a restored checkpoint) and exit
     use_trivial_model: bool = False     # --use_trivial_model (imagenet_main.py:189-191)
     report_accuracy_metrics: bool = True  # --report_accuracy_metrics (common.py:277-278)
     use_tensor_lr: bool = False         # --use_tensor_lr → PiecewiseConstantDecayWithWarmup
@@ -133,6 +134,9 @@ class Config:
     # rematerialization (jax.checkpoint) around each transformer block:
     # trade recompute FLOPs for HBM — the long-context memory lever
     remat: bool = False
+    # clip gradients to this global L2 norm (computed across every
+    # shard of every parameter); None = no clipping
+    clip_grad_norm: Optional[float] = None
 
     # --- misc ---
     seed: int = 0
@@ -164,6 +168,15 @@ class Config:
                     raise ValueError(
                         f"loss_scale must be a positive finite number, "
                         f"got {val}")
+        if self.clip_grad_norm is not None:
+            import math
+            if (not math.isfinite(self.clip_grad_norm)
+                    or self.clip_grad_norm <= 0):
+                raise ValueError(
+                    f"clip_grad_norm must be a positive finite number, "
+                    f"got {self.clip_grad_norm}")
+        if self.eval_only and self.skip_eval:
+            raise ValueError("--eval_only contradicts --skip_eval")
 
     # -- dtype helpers -------------------------------------------------
     @property

@@ -328,6 +328,37 @@ class Trainer:
                 red, param_specs, grads,
                 is_leaf=lambda x: isinstance(x, P))
 
+        clip_norm = self.cfg.clip_grad_norm
+
+        def clip_grads(grads):
+            """Clip to the TRUE global L2 norm: a leaf sharded over a
+            mesh axis holds distinct elements per shard, so its local
+            sum-of-squares is psum-ed over that axis; replicated leaves
+            contribute their full sum once.  Every shard computes the
+            same norm, so the scaling stays replica-consistent."""
+            if not clip_norm:
+                return grads
+
+            def leaf_sumsq(spec, g):
+                ss = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                axes = tuple(_spec_axes(spec)) if spec is not None else ()
+                if axes:
+                    ss = lax.psum(ss, axes)
+                return ss
+
+            if param_specs is None:
+                parts = jax.tree_util.tree_map(
+                    lambda g: leaf_sumsq(None, g), grads)
+            else:
+                parts = jax.tree_util.tree_map(
+                    leaf_sumsq, param_specs, grads,
+                    is_leaf=lambda x: isinstance(x, P))
+            sumsq = sum(jax.tree_util.tree_leaves(parts))
+            norm = jnp.sqrt(sumsq)
+            factor = jnp.minimum(1.0, clip_norm / jnp.maximum(norm, 1e-12))
+            return jax.tree_util.tree_map(
+                lambda g: (g * factor).astype(g.dtype), grads)
+
         dynamic = self.dynamic_scale
         vocab_axis = self.vocab_axis
 
@@ -394,6 +425,7 @@ class Trainer:
             # PS push-pull, SURVEY §3); includes 'seq' when the sequence
             # dimension is sharded (each shard's loss covers 1/sp tokens)
             grads = reduce_grads(grads)
+            grads = clip_grads(grads)
             # per-replica BN stats averaged on update — MirroredStrategy's
             # variable aggregation semantics
             new_stats = jax.lax.pmean(new_stats, reduce_axes)

@@ -110,6 +110,20 @@ def test_run_with_checkpoint_and_resume(tmp_path):
     assert "loss" not in stats2 or stats2.get("train_finish_time")
 
 
+def test_eval_only_from_checkpoint(tmp_path):
+    """Train + save, then --eval_only --resume evaluates the restored
+    state without training."""
+    base = dict(model="resnet20", dataset="cifar10", batch_size=8,
+                train_steps=2, use_synthetic_data=True, skip_eval=True,
+                model_dir=str(tmp_path), log_steps=1,
+                distribution_strategy="off")
+    run(Config(**base))
+    stats = run(Config(**dict(base, skip_eval=False, resume=True,
+                              eval_only=True)))
+    assert np.isfinite(stats["eval_loss"])
+    assert "loss" not in stats  # no training happened
+
+
 def test_tensorboard_event_file(tmp_path):
     w = SummaryWriter(str(tmp_path))
     w.scalar("loss", 1.5, step=10)

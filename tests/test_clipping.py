@@ -1,0 +1,80 @@
+"""Global-norm gradient clipping: the norm must be the TRUE global
+norm — sharded leaves' sum-of-squares psum-ed over their mesh axes —
+so a TP run clips exactly like the unsharded run."""
+
+import dataclasses
+import functools
+
+import jax
+import numpy as np
+import pytest
+
+import dtf_tpu.data.base as data_base
+from dtf_tpu.config import Config
+from dtf_tpu.models.transformer import TransformerLM, param_partition_specs
+from dtf_tpu.runtime import initialize
+from dtf_tpu.runtime.mesh import MODEL_AXIS
+from dtf_tpu.train import Trainer
+
+TINY_LM = dataclasses.replace(data_base.LM, num_classes=64, seq_len=16,
+                              num_train=64, num_eval=16)
+
+
+@pytest.fixture(autouse=True)
+def tiny_lm_spec(monkeypatch):
+    monkeypatch.setitem(data_base._SPECS, "lm", TINY_LM)
+
+
+def _one_step(mp: int, clip):
+    cfg = Config(model="transformer", dataset="lm", batch_size=4,
+                 train_steps=1, use_synthetic_data=True, skip_eval=True,
+                 skip_checkpoint=True, model_dir="", optimizer="sgd",
+                 clip_grad_norm=clip,
+                 distribution_strategy="off" if mp == 1 else "mirrored",
+                 model_parallelism=mp, num_devices=mp)
+    rt = initialize(cfg)
+    model = TransformerLM(
+        vocab_size=64, num_layers=2, d_model=32, num_heads=4, d_ff=64,
+        max_seq_len=16, model_axis=MODEL_AXIS if mp > 1 else None,
+        use_pallas=False)
+    spec_fn = (functools.partial(param_partition_specs,
+                                 model_axis=MODEL_AXIS) if mp > 1 else None)
+    trainer = Trainer(cfg, rt, model, 0.0, TINY_LM,
+                      schedule=lambda s: 0.1, param_spec_fn=spec_fn)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 64, (4, 16)).astype(np.int32)
+    labels = np.roll(tokens, -1, 1)
+    state = trainer.init_state(jax.random.key(0), (tokens, labels))
+    state, metrics = trainer.train_step(state,
+                                        *rt.shard_batch((tokens, labels)))
+    return jax.device_get(state.params)
+
+
+def _flat(params):
+    return dict(jax.tree_util.tree_leaves_with_path(params))
+
+
+def test_clip_is_exact_under_tensor_parallelism(eight_devices):
+    """Same clip threshold, same data: TP-updated params ≡ unsharded
+    updated params (wrong norm accounting would scale the update)."""
+    ref = _flat(_one_step(1, clip=0.05))
+    tp = _flat(_one_step(4, clip=0.05))
+    for path, r in ref.items():
+        np.testing.assert_allclose(np.asarray(r), np.asarray(tp[path]),
+                                   atol=1e-5, rtol=1e-4,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_clip_actually_clips(eight_devices):
+    """A tiny threshold must change the update; a huge one must not."""
+    base = _flat(_one_step(1, clip=None))
+    huge = _flat(_one_step(1, clip=1e9))
+    tiny = _flat(_one_step(1, clip=1e-4))
+    some_equal = all(
+        np.allclose(np.asarray(base[p]), np.asarray(huge[p]), atol=1e-7)
+        for p in base)
+    assert some_equal, "clip=1e9 should be a no-op"
+    diff = any(
+        not np.allclose(np.asarray(base[p]), np.asarray(tiny[p]), atol=1e-7)
+        for p in base)
+    assert diff, "clip=1e-4 should shrink the update"
