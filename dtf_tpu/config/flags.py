@@ -137,6 +137,13 @@ class Config:
     # clip gradients to this global L2 norm (computed across every
     # shard of every parameter); None = no clipping
     clip_grad_norm: Optional[float] = None
+    # ZeRO-1 / weight-update sharding (Xu et al. 2020, "Automatic
+    # Cross-Replica Sharding of Weight Update in Data-Parallel
+    # Training"): reduce-scatter gradients, update a 1/N parameter
+    # slice per data shard with 1/N optimizer state, all-gather the
+    # updated params — optimizer memory and update FLOPs drop by the
+    # data-parallel degree at equal communication volume
+    optimizer_sharding: bool = False
 
     # --- misc ---
     seed: int = 0
@@ -177,6 +184,11 @@ class Config:
                     f"got {self.clip_grad_norm}")
         if self.eval_only and self.skip_eval:
             raise ValueError("--eval_only contradicts --skip_eval")
+        if self.eval_only and not self.resume:
+            raise ValueError(
+                "--eval_only evaluates a restored checkpoint; pass "
+                "--resume (and --model_dir) or there is nothing to "
+                "evaluate but random init")
 
     # -- dtype helpers -------------------------------------------------
     @property

@@ -415,10 +415,11 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
             "--shard_lm_head/--model_parallelism/--seq_parallelism need "
             "the SPMD path; async parameter-server workers are "
             "single-device")
-    if cfg.eval_only or cfg.clip_grad_norm:
+    if cfg.eval_only or cfg.clip_grad_norm or cfg.optimizer_sharding:
         raise ValueError(
-            "--eval_only/--clip_grad_norm are not implemented for async "
-            "parameter-server mode; use --ps_mode sync")
+            "--eval_only/--clip_grad_norm/--optimizer_sharding are not "
+            "implemented for async parameter-server mode; use "
+            "--ps_mode sync")
     model, l2w = build_model(model_name, num_classes=spec.num_classes,
                              dtype=cfg.compute_dtype)
 

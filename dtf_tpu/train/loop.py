@@ -67,6 +67,17 @@ DYNAMIC_SCALE_INIT = 2.0 ** 15
 DYNAMIC_GROWTH_INTERVAL = 2000
 
 
+def _pad_flat(p, nd: int):
+    """Flatten and zero-pad to a multiple of `nd` (the ZeRO slice
+    grid); padding lives at the tail and is sliced off after gather."""
+    flat = p.reshape(-1)
+    k = -(-flat.size // nd)
+    pad = nd * k - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
 def cross_entropy(logits, labels):
     """Mean CE with integer labels; numerically identical to the
     reference's categorical CE over one-hot labels."""
@@ -193,7 +204,18 @@ class Trainer:
         self.loss_scale = (1.0 if self.dynamic_scale
                            else float(cfg.loss_scale_value))
 
-        if self.param_spec_fn is None:
+        # ZeRO-1 weight-update sharding (PAPERS.md: Xu et al. 2020):
+        # optimizer state lives sliced over the data axis, gradients
+        # reduce-scatter instead of all-reduce, updated slices
+        # all-gather back.  Orthogonal model sharding (TP/EP/PP specs)
+        # is not composed with it yet.
+        self.zero = bool(cfg.optimizer_sharding)
+        if self.zero and self.param_spec_fn is not None:
+            raise ValueError(
+                "--optimizer_sharding composes with pure data parallelism "
+                "only (not TP/EP/PP param sharding) for now")
+
+        if self.param_spec_fn is None and not self.zero:
             self._build_steps()
         # else: the state spec tree needs the concrete param structure —
         # steps are built in init_state
@@ -220,7 +242,24 @@ class Trainer:
             rng, images, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
-        opt_state = self.tx.init(params)
+        if self.zero:
+            # optimizer state over PADDED FLAT leaves [nd·k]; sharding
+            # dim 0 over 'data' leaves each shard its [k] slice.  Init
+            # under jit with sharded out_shardings so the full state
+            # never materializes on one device (the transient spike
+            # would OOM exactly the model sizes this feature targets)
+            from dtf_tpu.train.optimizer import opt_state_specs
+            nd = self.rt.mesh.shape[DATA_AXIS]
+            opt_pspecs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
+                                                params)
+            ospecs = opt_state_specs(self.cfg.optimizer, opt_pspecs, P())
+            oshard = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.rt.mesh, s), ospecs,
+                is_leaf=lambda x: isinstance(x, P))
+            opt_state = jax.jit(self.tx.init, out_shardings=oshard)(
+                jax.tree_util.tree_map(lambda p: _pad_flat(p, nd), params))
+        else:
+            opt_state = self.tx.init(params)
         state = TrainState(
             step=jnp.zeros((), jnp.int32), params=params,
             batch_stats=batch_stats, opt_state=opt_state,
@@ -228,6 +267,13 @@ class Trainer:
                         if self.dynamic_scale else None),
             good_steps=(jnp.zeros((), jnp.int32)
                         if self.dynamic_scale else None))
+        if self.zero:
+            state_specs = self._make_zero_state_specs(state)
+            self._build_steps(state_specs)
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.rt.mesh, s), state_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            return jax.device_put(state, shardings)
         if self.param_spec_fn is None:
             # replicate across the mesh
             return jax.device_put(state, self.rt.replicated())
@@ -239,6 +285,20 @@ class Trainer:
             lambda s: NamedSharding(self.rt.mesh, s), state_specs,
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(state, shardings)
+
+    def _make_zero_state_specs(self, state: TrainState):
+        from dtf_tpu.train.optimizer import opt_state_specs
+        rep = P()
+        opt_pspecs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
+                                            state.params)
+        return TrainState(
+            step=rep,
+            params=jax.tree_util.tree_map(lambda _: rep, state.params),
+            batch_stats=jax.tree_util.tree_map(lambda _: rep,
+                                               state.batch_stats),
+            opt_state=opt_state_specs(self.cfg.optimizer, opt_pspecs, rep),
+            loss_scale=rep if self.dynamic_scale else None,
+            good_steps=rep if self.dynamic_scale else None)
 
     def _make_state_specs(self, state: TrainState):
         from dtf_tpu.train.optimizer import opt_state_specs
@@ -361,6 +421,7 @@ class Trainer:
 
         dynamic = self.dynamic_scale
         vocab_axis = self.vocab_axis
+        zero = self.zero
 
         def compute_ce(logits, labels):
             if vocab_axis is not None:
@@ -420,19 +481,64 @@ class Trainer:
                 loss, acc = lsum / accum, asum / accum
             if dynamic or loss_scale != 1.0:
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
-            # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
-            # batch-splitting axes (≡ NCCL ring / collective allreduce /
-            # PS push-pull, SURVEY §3); includes 'seq' when the sequence
-            # dimension is sharded (each shard's loss covers 1/sp tokens)
-            grads = reduce_grads(grads)
-            grads = clip_grads(grads)
             # per-replica BN stats averaged on update — MirroredStrategy's
             # variable aggregation semantics
             new_stats = jax.lax.pmean(new_stats, reduce_axes)
 
-            updates, new_opt = self.tx.update(
-                grads, state.opt_state, state.params, step=state.step)
-            params = optax.apply_updates(state.params, updates)
+            if zero:
+                # ZeRO-1 weight-update sharding: the gradient all-reduce
+                # becomes a reduce-scatter (same ICI volume), each data
+                # shard updates its 1/nd slice with its 1/nd optimizer
+                # state, and the updated slices all-gather back
+                nd = mesh_shape[DATA_AXIS]
+                idx = lax.axis_index(DATA_AXIS)
+
+                def scatter(g):
+                    flat = _pad_flat(g.astype(jnp.float32), nd)
+                    s = lax.psum_scatter(flat, DATA_AXIS,
+                                         scatter_dimension=0,
+                                         tiled=True) / nd
+                    return lax.pmean(s, SEQ_AXIS)
+
+                g_slices = jax.tree_util.tree_map(scatter, grads)
+                if clip_norm:
+                    sumsq = sum(
+                        lax.psum(jnp.sum(jnp.square(s)), DATA_AXIS)
+                        for s in jax.tree_util.tree_leaves(g_slices))
+                    norm = jnp.sqrt(sumsq)
+                    factor = jnp.minimum(
+                        1.0, clip_norm / jnp.maximum(norm, 1e-12))
+                    g_slices = jax.tree_util.tree_map(
+                        lambda s: s * factor, g_slices)
+
+                def pslice(p):
+                    flat = _pad_flat(p, nd)
+                    k = flat.shape[0] // nd
+                    return lax.dynamic_slice_in_dim(flat, idx * k, k)
+
+                p_slices = jax.tree_util.tree_map(pslice, state.params)
+                updates, new_opt = self.tx.update(
+                    g_slices, state.opt_state, p_slices, step=state.step)
+                new_slices = optax.apply_updates(p_slices, updates)
+
+                def gather(ns, p):
+                    full = lax.all_gather(ns, DATA_AXIS, axis=0,
+                                          tiled=True)
+                    return full[:p.size].reshape(p.shape).astype(p.dtype)
+
+                params = jax.tree_util.tree_map(gather, new_slices,
+                                                state.params)
+                grads = g_slices  # the dynamic-scale finite check below
+            else:
+                # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
+                # batch-splitting axes (≡ NCCL ring / collective
+                # allreduce / PS push-pull, SURVEY §3); includes 'seq'
+                # when the sequence dimension is sharded
+                grads = reduce_grads(grads)
+                grads = clip_grads(grads)
+                updates, new_opt = self.tx.update(
+                    grads, state.opt_state, state.params, step=state.step)
+                params = optax.apply_updates(state.params, updates)
             new_scale, new_good = state.loss_scale, state.good_steps
             if dynamic:
                 # TF2 LossScaleOptimizer semantics: skip the update on
