@@ -134,7 +134,8 @@ def run(cfg: Config) -> dict:
         model_kw = {k: v for k, v in dict(
             num_experts=cfg.num_experts,
             capacity_factor=cfg.moe_capacity_factor,
-            aux_weight=cfg.moe_aux_weight).items() if v is not None}
+            aux_weight=cfg.moe_aux_weight,
+            router_top_k=cfg.moe_top_k).items() if v is not None}
     elif is_pipeline and cfg.num_microbatches is not None:
         model_kw = dict(num_microbatches=cfg.num_microbatches)
     if cfg.remat:

@@ -121,6 +121,7 @@ class Config:
     num_experts: Optional[int] = None   # total experts; sharded over 'data' (EP)
     moe_capacity_factor: Optional[float] = None  # per-expert capacity multiplier
     moe_aux_weight: Optional[float] = None  # load-balance aux-loss weight
+    moe_top_k: Optional[int] = None     # router choices: 1=Switch, 2=GShard
     # --- pipeline parallelism (pipeline_transformer family) ---
     num_microbatches: Optional[int] = None  # GPipe microbatches per step
 

@@ -6,12 +6,15 @@ parallelism matrix TPU-first.
 
 Design (GShard/Switch lineage, re-expressed for XLA):
   - **Static-shape dispatch.** Routing never gathers with dynamic
-    shapes: a top-2 router builds dense one-hot dispatch/combine
-    tensors ``[tokens, experts, capacity]`` (capacity is a Python int
-    at trace time), and tokens move to experts as two einsums — pure
-    MXU work that XLA tiles freely.  Tokens beyond an expert's
-    capacity are dropped (their MoE output is 0; the residual carries
-    them), exactly the GShard overflow rule.
+    shapes: a top-k router (k=1 Switch, k=2 GShard — ``router_top_k``)
+    builds dense one-hot dispatch/combine tensors ``[tokens, experts,
+    capacity]`` (capacity is a Python int at trace time, scaling with
+    k), and tokens move to experts as two einsums — pure MXU work that
+    XLA tiles freely.  Tokens beyond an expert's capacity are dropped
+    (their MoE output is 0; the residual carries them), exactly the
+    GShard overflow rule.  Top-2 gates renormalize to sum to 1
+    (GShard); top-1 keeps the raw router probability (Switch — the
+    router's gradient path).
   - **Expert parallelism rides the 'data' axis.** Experts shard over
     the same mesh axis the batch is sharded over (the classic
     DeepSpeed-MoE/GShard placement): each data shard holds
@@ -46,7 +49,8 @@ from dtf_tpu.models.transformer import Block, CausalSelfAttention
 
 
 class MoEMLP(nn.Module):
-    """Top-2 routed expert MLP with static capacity.
+    """Top-k routed expert MLP with static capacity (k=1 Switch, k=2
+    GShard; see module docstring for gate semantics).
 
     Call with ``x: [batch, seq, d_model]``; returns the same shape.
     ``expert_axis`` names the mesh axis experts are sharded over (the
@@ -57,6 +61,7 @@ class MoEMLP(nn.Module):
     num_experts: int
     d_ff: int
     capacity_factor: float = 1.25
+    router_top_k: int = 2    # 1 = Switch routing, 2 = GShard top-2
     dtype: Any = jnp.float32
     expert_axis: Optional[str] = None
     aux_weight: float = 0.01
@@ -89,40 +94,59 @@ class MoEMLP(nn.Module):
             tokens.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)            # [n, E]
 
-        idx1 = jnp.argmax(probs, axis=-1)
-        m1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)    # [n, E]
-        idx2 = jnp.argmax(probs * (1.0 - m1), axis=-1)
-        m2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+        k = self.router_top_k
+        if k < 1:
+            raise ValueError(f"router_top_k {k} must be >= 1")
+        k = min(k, e)  # a single expert degenerates top-2 to top-1
+        # iterative top-k: each choice takes the argmax of what earlier
+        # choices left (k=1 is Switch routing, k=2 is GShard's top-2)
+        masks = []
+        remaining = probs
+        for _ in range(k):
+            idx_c = jnp.argmax(remaining, axis=-1)
+            m_c = jax.nn.one_hot(idx_c, e, dtype=jnp.float32)  # [n, E]
+            masks.append(m_c)
+            remaining = remaining * (1.0 - m_c)
 
         # load balance: fraction routed (first choice) × mean prob
-        frac = jnp.mean(m1, axis=0)
+        frac = jnp.mean(masks[0], axis=0)
         p_mean = jnp.mean(probs, axis=0)
         aux = e * jnp.sum(frac * p_mean)
         self.sow("aux_loss", "load_balance", self.aux_weight * aux)
 
         # ---- capacity positions (static C) --------------------------
-        cap = max(1, min(n, int(round(self.capacity_factor * 2 * n / e))))
-        pos1 = jnp.sum((jnp.cumsum(m1, axis=0) - m1) * m1, axis=-1)  # [n]
-        count1 = jnp.sum(m1, axis=0, keepdims=True)        # [1, E]
-        pos2 = jnp.sum((jnp.cumsum(m2, axis=0) - m2 + count1) * m2, axis=-1)
-        keep1 = (pos1 < cap).astype(jnp.float32)
-        keep2 = (pos2 < cap).astype(jnp.float32)
-
-        g1 = jnp.sum(probs * m1, axis=-1) * keep1
-        g2 = jnp.sum(probs * m2, axis=-1) * keep2
-        denom = jnp.where(g1 + g2 > 0, g1 + g2, 1.0)
-        g1, g2 = g1 / denom, g2 / denom
-
-        # one_hot of an out-of-range position is all-zero, so dropped
-        # tokens vanish from dispatch/combine automatically
-        oh1 = jax.nn.one_hot(pos1.astype(jnp.int32), cap,
-                             dtype=jnp.float32) * keep1[:, None]
-        oh2 = jax.nn.one_hot(pos2.astype(jnp.int32), cap,
-                             dtype=jnp.float32) * keep2[:, None]
-        dispatch = (m1[:, :, None] * oh1[:, None, :]
-                    + m2[:, :, None] * oh2[:, None, :])    # [n, E, C]
-        combine = (g1[:, None, None] * m1[:, :, None] * oh1[:, None, :]
-                   + g2[:, None, None] * m2[:, :, None] * oh2[:, None, :])
+        cap = max(1, min(n, int(round(self.capacity_factor * k * n / e))))
+        dispatch = jnp.zeros((n, e, cap), jnp.float32)
+        combine = jnp.zeros((n, e, cap), jnp.float32)
+        gates, keeps, slots = [], [], []
+        count_prev = jnp.zeros((1, e), jnp.float32)
+        for m_c in masks:
+            # a choice's slots start after every earlier choice's tokens
+            pos_c = jnp.sum(
+                (jnp.cumsum(m_c, axis=0) - m_c + count_prev) * m_c,
+                axis=-1)                                    # [n]
+            count_prev = count_prev + jnp.sum(m_c, axis=0, keepdims=True)
+            keep_c = (pos_c < cap).astype(jnp.float32)
+            gates.append(jnp.sum(probs * m_c, axis=-1) * keep_c)
+            keeps.append(keep_c)
+            slots.append(pos_c)
+        if k > 1:
+            # GShard renormalizes the kept top-k gates to sum to 1
+            denom = sum(gates)
+            denom = jnp.where(denom > 0, denom, 1.0)
+        else:
+            # Switch keeps the raw router probability — renormalizing
+            # would make the gate a constant 1 and starve the router of
+            # gradient signal
+            denom = 1.0
+        for m_c, g_c, keep_c, pos_c in zip(masks, gates, keeps, slots):
+            # one_hot of an out-of-range position is all-zero, so
+            # dropped tokens vanish from dispatch/combine automatically
+            oh_c = jax.nn.one_hot(pos_c.astype(jnp.int32), cap,
+                                  dtype=jnp.float32) * keep_c[:, None]
+            slot = m_c[:, :, None] * oh_c[:, None, :]       # [n, E, C]
+            dispatch = dispatch + slot
+            combine = combine + (g_c / denom)[:, None, None] * slot
         dispatch = lax.stop_gradient(dispatch)
 
         # ---- dispatch → experts → combine ---------------------------
@@ -154,6 +178,7 @@ class MoEBlock(nn.Module):
     d_ff: int
     num_experts: int
     capacity_factor: float = 1.25
+    router_top_k: int = 2
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     expert_axis: Optional[str] = None
@@ -169,7 +194,8 @@ class MoEBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         return x + MoEMLP(
             self.num_experts, self.d_ff,
-            capacity_factor=self.capacity_factor, dtype=self.dtype,
+            capacity_factor=self.capacity_factor,
+            router_top_k=self.router_top_k, dtype=self.dtype,
             expert_axis=self.expert_axis, aux_weight=self.aux_weight,
             name="moe")(h)
 
@@ -191,6 +217,7 @@ class MoETransformerLM(nn.Module):
     num_experts: int = 8
     moe_every: int = 2
     capacity_factor: float = 1.25
+    router_top_k: int = 2
     aux_weight: float = 0.01
     max_seq_len: int = 2048
     dtype: Any = jnp.float32
@@ -222,7 +249,8 @@ class MoETransformerLM(nn.Module):
             if (i % self.moe_every) == self.moe_every - 1:
                 x = moe_block(
                     self.num_heads, self.d_ff, self.num_experts,
-                    capacity_factor=self.capacity_factor, dtype=self.dtype,
+                    capacity_factor=self.capacity_factor,
+                    router_top_k=self.router_top_k, dtype=self.dtype,
                     seq_axis=self.seq_axis, expert_axis=self.expert_axis,
                     aux_weight=self.aux_weight, use_pallas=self.use_pallas,
                     name=f"block{i}")(x)

@@ -165,6 +165,35 @@ def test_ep_grads_match_unsharded(eight_devices):
             err_msg=jax.tree_util.keystr(path))
 
 
+def test_top1_switch_routing():
+    """k=1 (Switch): each kept token's output is its single expert's
+    MLP output weighted by the RAW router probability (Switch keeps p
+    as the gate — that is the router's gradient path)."""
+    layer = MoEMLP(num_experts=2, d_ff=16, capacity_factor=100.0,
+                   router_top_k=1)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 4, 8)),
+                    jnp.float32)
+    params = layer.init(jax.random.key(0), x)["params"]
+    y = layer.apply({"params": params}, x)
+    tokens = np.asarray(x).reshape(8, 8)
+    logits = tokens @ np.asarray(params["router"]["kernel"]) + np.asarray(
+        params["router"]["bias"])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), -1))
+    choice = logits.argmax(-1)
+    ref = np.stack([
+        probs[i, c] * np.asarray(jax.nn.gelu(
+            t @ params["w1"][c] + params["b1"][c]) @ params["w2"][c]
+            + params["b2"][c])
+        for i, (t, c) in enumerate(zip(tokens, choice))])
+    np.testing.assert_allclose(np.asarray(y).reshape(8, 8), ref,
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_top1_ep_training(tiny_moe_registry):
+    stats = run(base_cfg(num_devices=2, moe_top_k=1))
+    assert np.isfinite(stats["loss"])
+
+
 def test_moe_partition_spec_rules():
     model = tiny_moe()
     tokens = jnp.zeros((1, 16), jnp.int32)
