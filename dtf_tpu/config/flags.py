@@ -185,6 +185,8 @@ class Config:
                     f"got {self.clip_grad_norm}")
         if self.eval_only and self.skip_eval:
             raise ValueError("--eval_only contradicts --skip_eval")
+        if self.moe_top_k is not None and self.moe_top_k < 1:
+            raise ValueError(f"moe_top_k must be >= 1, got {self.moe_top_k}")
         if self.eval_only and not self.resume:
             raise ValueError(
                 "--eval_only evaluates a restored checkpoint; pass "

@@ -27,18 +27,20 @@ def tiny_specs(monkeypatch):
     monkeypatch.setitem(data_base._SPECS, "cifar10", TINY)
 
 
-def _steps(zero: bool, clip=None, steps: int = 2):
+def _steps(zero: bool, clip=None, steps: int = 2, num_devices: int = 4,
+           accum: int = 1, seed: int = 0):
     cfg = Config(model="resnet20", dataset="cifar10", batch_size=8,
                  train_steps=steps, use_synthetic_data=True, skip_eval=True,
                  skip_checkpoint=True, model_dir="", log_steps=1,
-                 distribution_strategy="mirrored", num_devices=4,
-                 optimizer_sharding=zero, clip_grad_norm=clip)
+                 distribution_strategy="mirrored", num_devices=num_devices,
+                 optimizer_sharding=zero, clip_grad_norm=clip,
+                 grad_accum_steps=accum)
     rt = initialize(cfg)
     spec = TINY
     model, l2 = build_model("resnet20")
     trainer = Trainer(cfg, rt, model, l2, spec,
                       schedule=lambda s: 0.1)
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     images = rng.normal(120, 50, (8, 8, 8, 3)).astype(np.float32)
     labels = rng.integers(0, 10, (8,)).astype(np.int32)
     state = trainer.init_state(jax.random.key(0), (images, labels))
@@ -98,6 +100,29 @@ def test_zero_rejects_model_sharding(eight_devices):
                    skip_checkpoint=True, model_dir="", optimizer="adamw",
                    model_parallelism=2, optimizer_sharding=True,
                    seq_len=16, num_classes=64))
+
+
+def test_zero_with_grad_accum_matches(eight_devices):
+    """ZeRO slices the already-accumulated gradient: composing the two
+    must still match plain DP exactly."""
+    ref = _flat_params(_steps(False, steps=1, num_devices=2, accum=2,
+                              seed=1)[0])
+    z = _flat_params(_steps(True, steps=1, num_devices=2, accum=2,
+                            seed=1)[0])
+    for path, r in ref.items():
+        np.testing.assert_allclose(np.asarray(r), np.asarray(z[path]),
+                                   atol=2e-6, rtol=1e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_zero_with_dynamic_loss_scale(eight_devices):
+    stats = run(Config(model="resnet20", dataset="cifar10", batch_size=8,
+                       train_steps=2, use_synthetic_data=True,
+                       skip_eval=True, skip_checkpoint=True, model_dir="",
+                       log_steps=1, distribution_strategy="mirrored",
+                       num_devices=2, optimizer_sharding=True,
+                       dtype="fp16", loss_scale="dynamic"))
+    assert np.isfinite(stats["loss"])
 
 
 def test_zero_e2e_cli():
