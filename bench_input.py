@@ -1,0 +1,87 @@
+"""Input-pipeline-only benchmark: ImageNet decode+augment throughput.
+
+Measures the host-side production path (TFRecord read → Example parse →
+fused C++ decode-crop-flip-resize-mean-subtract batches) on synthetic
+JPEG shards, with no device in the loop.  Prints ONE JSON line:
+
+  value            images/sec sustained by this host
+  per_core         value / cpu cores (the portable number)
+  chip_demand      what one TPU chip consumes at bench.py speed
+  cores_needed     chip_demand / per_core — host provisioning guide
+
+The reference's equivalent number: its pipeline fed ~168.6 img/s per
+P40 with tf.data's C++ kernels (ps_server/log1.log).  A multi-core TPU
+host must feed ~2,400+ img/s per chip (BENCH_r02); this bench proves
+the per-core rate and therefore the core count that achieves it.
+"""
+
+import io
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+NUM_SHARDS = 4
+IMAGES_PER_SHARD = 400
+MEASURE_IMAGES = 1600
+CHIP_DEMAND = 2430.0  # img/s one chip consumes (BENCH_r02 measurement)
+
+
+def make_shards(root: str):
+    from PIL import Image
+    from dtf_tpu.data import records
+    rng = np.random.default_rng(0)
+    for shard in range(NUM_SHARDS):
+        recs = []
+        for _ in range(IMAGES_PER_SHARD):
+            # ImageNet-ish JPEG: ~500×375, quality 90
+            h, w = int(rng.integers(350, 420)), int(rng.integers(450, 550))
+            arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            recs.append(records.build_example({
+                "image/encoded": buf.getvalue(),
+                "image/class/label": [int(rng.integers(1, 1001))],
+            }))
+        records.write_tfrecord_file(
+            os.path.join(root, f"train-{shard:05d}-of-01024"), recs)
+
+
+def main():
+    from dtf_tpu.data.imagenet import imagenet_input_fn, native_jpeg_module
+
+    with tempfile.TemporaryDirectory() as root:
+        make_shards(root)
+        batch = 64
+        it = imagenet_input_fn(root, True, batch, seed=0, process_id=0,
+                               process_count=1)
+        # warmup: first batches pay thread spin-up + shuffle-buffer fill
+        for _ in range(4):
+            next(it)
+        t0 = time.perf_counter()
+        seen = 0
+        while seen < MEASURE_IMAGES:
+            images, labels = next(it)
+            seen += len(labels)
+        elapsed = time.perf_counter() - t0
+        assert images.shape[1:] == (224, 224, 3)
+
+    cores = os.cpu_count() or 1
+    rate = seen / elapsed
+    per_core = rate / cores
+    print(json.dumps({
+        "metric": "imagenet_input_pipeline_images_per_sec_per_host",
+        "value": round(rate, 1),
+        "unit": "images/sec/host",
+        "cores": cores,
+        "per_core": round(per_core, 1),
+        "native_batch_decode": native_jpeg_module() is not None,
+        "chip_demand": CHIP_DEMAND,
+        "cores_needed_per_chip": round(CHIP_DEMAND / per_core, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

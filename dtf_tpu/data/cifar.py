@@ -101,6 +101,14 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
     (cifar_preprocessing.py:147-152 semantics). `batch_size` is the
     per-host batch (global / process_count), matching how the loop's
     shard_batch assembles the global array.
+
+    Eval with ``drop_remainder=False`` (the default config): examples
+    are stride-sharded across processes and the final partial batch is
+    zero-padded with a mask — batches are ``(images, labels, mask)``
+    3-tuples, every process yields the same batch count, and eval
+    covers exactly the full 10k test set once (the reference's full-set
+    eval).  ``drop_remainder=True`` keeps the 2-tuple
+    every-host-reads-everything behavior (benchmark purity).
     """
     import jax
     process_id = jax.process_index() if process_id is None else process_id
@@ -126,11 +134,29 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
                     idx = order[i:i + batch_size]
                     batch = augment_batch(images[idx], rng)
                     yield standardize(batch), labels[idx]
-        else:
-            end = (len(images) - batch_size + 1 if drop_remainder
-                   else len(images))
-            for i in range(0, end, batch_size):
+        elif drop_remainder:
+            for i in range(0, len(images) - batch_size + 1, batch_size):
                 yield (standardize(images[i:i + batch_size].copy()),
                        labels[i:i + batch_size])
+        else:
+            # exact full-coverage eval: each process takes the stride
+            # slice [pid::pcount]; all processes compute the same batch
+            # count from the (globally known) total, so the collective
+            # eval steps stay aligned
+            total = len(images)
+            local_idx = np.arange(process_id, total, process_count)
+            max_local = -(-total // process_count)
+            nbatches = -(-max_local // batch_size)
+            for b in range(nbatches):
+                sel = local_idx[b * batch_size:(b + 1) * batch_size]
+                imgs = np.zeros((batch_size, HEIGHT, WIDTH, NUM_CHANNELS),
+                                np.float32)
+                lbls = np.zeros((batch_size,), np.int32)
+                mask = np.zeros((batch_size,), np.float32)
+                if len(sel):
+                    imgs[:len(sel)] = standardize(images[sel].copy())
+                    lbls[:len(sel)] = labels[sel]
+                    mask[:len(sel)] = 1.0
+                yield imgs, lbls, mask
 
     return gen()

@@ -222,18 +222,39 @@ def _record_stream(files, is_training: bool, rng: np.random.Generator,
 def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                       seed: int = 0, num_threads: Optional[int] = None,
                       process_id: Optional[int] = None,
-                      process_count: Optional[int] = None) -> Iterator:
-    """Yields (images float32 [B,224,224,3], labels int32 [B])."""
+                      process_count: Optional[int] = None,
+                      drop_remainder: bool = True) -> Iterator:
+    """Yields (images float32 [B,224,224,3], labels int32 [B]) — plus a
+    float32 validity mask [B] for eval with ``drop_remainder=False``.
+
+    Eval modes:
+      - ``drop_remainder=False`` (config default): eval FILES are
+        sharded across processes, each host counts its records via
+        header-seek (no payload I/O), hosts agree on the max batch
+        count, and final/short batches are zero-padded with mask=0 —
+        full 50k coverage, each example exactly once, no duplicated
+        multi-host decode work.
+      - ``drop_remainder=True``: every host reads the full eval set and
+        drops the final partial batch (2-tuples; r1 behavior).
+    """
     import jax
     process_id = jax.process_index() if process_id is None else process_id
     process_count = (jax.process_count() if process_count is None
                      else process_count)
     files = get_filenames(is_training, data_dir)
-    # shard only training files: eval must yield the same batch count on
-    # every host or the collective eval_step deadlocks (same reason the
-    # reference shards train pipelines only, cifar_preprocessing.py:147-152)
-    if is_training and process_count > 1:
-        files = shard_for_process(files, process_id, process_count) or files
+    pad_eval = (not is_training) and (not drop_remainder)
+    # drop-mode eval must yield the same batch count on every host or
+    # the collective eval_step deadlocks, so only padded eval shards its
+    # files (train always shards, cifar_preprocessing.py:147-152)
+    if (is_training or pad_eval) and process_count > 1:
+        files = shard_for_process(files, process_id, process_count)
+        if is_training and not files:
+            files = get_filenames(is_training, data_dir)
+    eval_batches = None
+    if pad_eval:
+        local_count = sum(records.count_tfrecord_records(f) for f in files)
+        from dtf_tpu.data.pipeline import all_processes_max
+        eval_batches = all_processes_max(-(-local_count // batch_size))
     num_threads = num_threads or min(8, (os.cpu_count() or 1) * 4)
     rng = np.random.default_rng(seed + 7919 * process_id)
 
@@ -262,6 +283,15 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
             for _ in range(num_threads):
                 raw_q.put(None)
 
+    # Batched native fast path (train only): Python workers parse the
+    # record and sample the crop/flip (cheap, header-only JPEG shape
+    # read); whole batches then go through ONE fused C++ call —
+    # decode-crop-flip-resize-mean-subtract across C++ threads with the
+    # GIL released (dtf_native.cpp dtf_jpeg_decode_crop_resize_batch).
+    nj = native_jpeg_module()
+    batch_native = (is_training and nj is not None
+                    and hasattr(nj, "decode_crop_resize_batch"))
+
     def worker(wid: int):
         wrng = np.random.default_rng(seed + 104729 * (process_id + 1) + wid)
         while True:
@@ -271,16 +301,80 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 return
             try:
                 buf, label, bbox = parse_example_record(raw)
-                img = (preprocess_train(buf, bbox, wrng) if is_training
-                       else preprocess_eval(buf))
-                out_q.put((img, label))
+                if batch_native:
+                    try:
+                        h, w = nj.shape(buf)
+                    except ValueError:
+                        # undecodable header → eager slow path
+                        out_q.put((preprocess_train(buf, bbox, wrng),
+                                   label, None, False))
+                        continue
+                    crop = sample_distorted_bbox(wrng, h, w, bbox)
+                    out_q.put((buf, label, crop, bool(wrng.random() < 0.5)))
+                else:
+                    img = (preprocess_train(buf, bbox, wrng) if is_training
+                           else preprocess_eval(buf))
+                    out_q.put((img, label))
             except Exception as e:
                 out_q.put(e)
                 return
 
+    def _slow_item(buf, crop, flip):
+        """Python fallback for images the batch decoder rejects."""
+        image = decode_jpeg(buf)
+        y, x, ch, cw = crop
+        cropped = image[y:y + ch, x:x + cw]
+        if flip:
+            cropped = cropped[:, ::-1]
+        out = _resize_bilinear(np.ascontiguousarray(cropped),
+                               DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE)
+        return out - CHANNEL_MEANS
+
     threading.Thread(target=reader, daemon=True).start()
     for w in range(num_threads):
         threading.Thread(target=worker, args=(w,), daemon=True).start()
+
+    def assemble_native(items):
+        labels = np.fromiter((it[1] for it in items), np.int32,
+                             count=len(items))
+        todo = [j for j, it in enumerate(items) if it[2] is not None]
+        out = ok = None
+        if todo:
+            out, ok = nj.decode_crop_resize_batch(
+                [items[j][0] for j in todo], [items[j][2] for j in todo],
+                [items[j][3] for j in todo], DEFAULT_IMAGE_SIZE,
+                DEFAULT_IMAGE_SIZE, CHANNEL_MEANS,
+                num_threads=num_threads)
+            if len(todo) == len(items) and ok.all():
+                return out, labels  # common case: zero extra copies
+        images = np.empty((len(items), DEFAULT_IMAGE_SIZE,
+                           DEFAULT_IMAGE_SIZE, NUM_CHANNELS), np.float32)
+        for j, (payload, _, crop, flip) in enumerate(items):
+            if crop is None:
+                images[j] = payload  # eagerly decoded in the worker
+        for pos, j in enumerate(todo):
+            buf, _, crop, flip = items[j]
+            images[j] = (out[pos] if ok[pos]
+                         else _slow_item(buf, crop, flip))
+        return images, labels
+
+    def gen_native():
+        items = []
+        done_workers = 0
+        try:
+            while done_workers < num_threads:
+                item = out_q.get()
+                if item is None:
+                    done_workers += 1
+                    continue
+                if isinstance(item, Exception):
+                    raise item
+                items.append(item)
+                if len(items) == batch_size:
+                    yield assemble_native(items)
+                    items = []
+        finally:
+            stop.set()
 
     def gen():
         images = np.empty((batch_size, DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE,
@@ -288,6 +382,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
         labels = np.empty((batch_size,), np.int32)
         filled = 0
         done_workers = 0
+        yielded = 0
         try:
             while done_workers < num_threads:
                 item = out_q.get()
@@ -299,9 +394,25 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 images[filled], labels[filled] = item
                 filled += 1
                 if filled == batch_size:
-                    yield images.copy(), labels.copy()
+                    if pad_eval:
+                        yield (images.copy(), labels.copy(),
+                               np.ones((batch_size,), np.float32))
+                    else:
+                        yield images.copy(), labels.copy()
                     filled = 0
+                    yielded += 1
+            if pad_eval:
+                # final partial batch zero-padded + fully-masked filler
+                # batches up to the agreed cross-host count
+                while yielded < eval_batches:
+                    mask = np.zeros((batch_size,), np.float32)
+                    mask[:filled] = 1.0
+                    images[filled:] = 0.0
+                    labels[filled:] = 0
+                    yield images.copy(), labels.copy(), mask
+                    filled = 0
+                    yielded += 1
         finally:
             stop.set()
 
-    return gen()
+    return gen_native() if batch_native else gen()

@@ -22,6 +22,19 @@ def shard_for_process(items, process_id: int, process_count: int):
     return items[process_id::process_count]
 
 
+def all_processes_max(value: int) -> int:
+    """Max of a host-local int across every process (identity when
+    single-process).  Lets sharded eval pipelines with uneven per-host
+    record counts agree on one collective batch count."""
+    import jax
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    vals = multihost_utils.process_allgather(np.asarray(value, np.int64))
+    return int(np.max(vals))
+
+
 class DevicePrefetcher:
     """Wraps a host batch iterator; yields mesh-sharded device arrays."""
 

@@ -103,6 +103,42 @@ def read_tfrecord_file(path: str, verify_crc: bool = False) -> Iterator[bytes]:
             yield data
 
 
+# (path, mtime_ns, size) → record count: repeated evals re-count the
+# same immutable shard files otherwise (one tiny seek+read per record —
+# noticeable on high-latency network storage)
+_COUNT_CACHE: dict = {}
+
+
+def count_tfrecord_records(path: str) -> int:
+    """Record count of one TFRecord file, skipping payloads via seek —
+    O(records) tiny reads, no payload I/O, cached per (path, mtime,
+    size).  Used by the exact-coverage eval to agree on the per-host
+    batch count ahead of decoding."""
+    import os
+    st = os.stat(path)
+    key = (path, st.st_mtime_ns, st.st_size)
+    cached = _COUNT_CACHE.get(key)
+    if cached is not None:
+        return cached
+    n = 0
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        end = f.tell()
+        pos = 0
+        while pos < end:
+            f.seek(pos)
+            header = f.read(12)
+            if len(header) < 12:
+                raise IOError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", header[:8])
+            pos += 12 + length + 4
+            if pos > end:
+                raise IOError(f"{path}: truncated record body")
+            n += 1
+    _COUNT_CACHE[key] = n
+    return n
+
+
 def write_tfrecord_file(path: str, records) -> None:
     """Writes serialized records with valid framing (for tests/tools)."""
     with open(path, "wb") as f:

@@ -11,6 +11,7 @@
 // environment).  Build: `make -C dtf_tpu/native`.
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <mutex>
 #include <cstdio>
@@ -224,6 +225,90 @@ int dtf_jpeg_decode_batch(const uint8_t** bufs, const int64_t* lens, int n,
                                out + static_cast<size_t>(i) * ch * cw * 3)) {
         failures.fetch_add(1);
       }
+    }
+  };
+  if (num_threads <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < num_threads; t++) threads.emplace_back(work);
+    for (auto& t : threads) t.join();
+  }
+  return failures.load();
+}
+
+// ---------------------------------------------------------------------------
+// Fused decode→crop→(flip)→bilinear-resize→mean-subtract batch — the
+// whole ImageNet train-time augmentation (imagenet_preprocessing.py
+// _decode_crop_and_flip + _resize_image + _mean_image_subtraction) per
+// image in one C++ pass, n images across num_threads threads, GIL-free.
+// Bilinear = half-pixel centers, no antialias (tf.image.resize v2).
+// Per-image variable crop windows; fixed [oh, ow] float32 output.
+// statuses[i] = 0 ok / 1 failed (caller re-decodes failures its own
+// way).  Returns the failure count.
+// ---------------------------------------------------------------------------
+
+static void bilinear_resize_sub(const uint8_t* src, int sh, int sw,
+                                float* dst, int oh, int ow, int flip,
+                                const float* sub) {
+  const float sy = static_cast<float>(sh) / oh;
+  const float sx = static_cast<float>(sw) / ow;
+  for (int r = 0; r < oh; r++) {
+    float fy = (r + 0.5f) * sy - 0.5f;
+    int y0 = static_cast<int>(floorf(fy));
+    float wy = fy - y0;
+    int ya = y0 < 0 ? 0 : (y0 >= sh ? sh - 1 : y0);
+    int yb = y0 + 1 < 0 ? 0 : (y0 + 1 >= sh ? sh - 1 : y0 + 1);
+    const uint8_t* rowa = src + static_cast<size_t>(ya) * sw * 3;
+    const uint8_t* rowb = src + static_cast<size_t>(yb) * sw * 3;
+    float* out_row = dst + static_cast<size_t>(r) * ow * 3;
+    for (int c = 0; c < ow; c++) {
+      // flip(resize(x)) == resize(flip(x)) for symmetric half-pixel
+      // sampling, so the flip fuses into the source column lookup
+      int cc = flip ? (ow - 1 - c) : c;
+      float fx = (cc + 0.5f) * sx - 0.5f;
+      int x0 = static_cast<int>(floorf(fx));
+      float wx = fx - x0;
+      int xa = x0 < 0 ? 0 : (x0 >= sw ? sw - 1 : x0);
+      int xb = x0 + 1 < 0 ? 0 : (x0 + 1 >= sw ? sw - 1 : x0 + 1);
+      for (int ch = 0; ch < 3; ch++) {
+        float top = (1.0f - wx) * rowa[xa * 3 + ch] + wx * rowa[xb * 3 + ch];
+        float bot = (1.0f - wx) * rowb[xa * 3 + ch] + wx * rowb[xb * 3 + ch];
+        out_row[c * 3 + ch] =
+            (1.0f - wy) * top + wy * bot - sub[ch];
+      }
+    }
+  }
+}
+
+int dtf_jpeg_decode_crop_resize_batch(
+    const uint8_t** bufs, const int64_t* lens, int n, const int* crops,
+    const uint8_t* flips, int oh, int ow, const float* sub, float* out,
+    uint8_t* statuses, int num_threads) {
+  std::atomic<int> next(0), failures(0);
+  auto work = [&]() {
+    std::vector<uint8_t> tmp;
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      const int* c = crops + i * 4;
+      int ch = c[2], cw = c[3];
+      if (ch <= 0 || cw <= 0) {
+        statuses[i] = 1;
+        failures.fetch_add(1);
+        continue;
+      }
+      tmp.resize(static_cast<size_t>(ch) * cw * 3);
+      if (dtf_jpeg_decode_crop(bufs[i], lens[i], c[0], c[1], ch, cw,
+                               tmp.data())) {
+        statuses[i] = 1;
+        failures.fetch_add(1);
+        continue;
+      }
+      bilinear_resize_sub(tmp.data(), ch, cw,
+                          out + static_cast<size_t>(i) * oh * ow * 3,
+                          oh, ow, flips ? flips[i] : 0, sub);
+      statuses[i] = 0;
     }
   };
   if (num_threads <= 1) {

@@ -73,3 +73,36 @@ def decode_batch(bufs, crops, ch: int, cw: int,
     if failures:
         raise ValueError(f"{failures}/{n} JPEGs failed to decode")
     return out
+
+
+def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
+                             sub, num_threads: int = 4):
+    """The whole train-time augmentation for a batch in one C++ call:
+    fused decode-and-crop (per-image variable windows) → horizontal
+    flip → bilinear resize (half-pixel centers, tf.image.resize v2
+    semantics) → channel-mean subtraction, across ``num_threads``
+    GIL-free threads.
+
+    Returns (float32 [n, out_h, out_w, 3], ok mask bool [n]); failed
+    images (rare decoder edge cases) have ok=False and undefined
+    content — the caller re-decodes them however it likes.
+    """
+    lib = _lib()
+    n = len(bufs)
+    out = np.empty((n, out_h, out_w, 3), np.float32)
+    statuses = np.empty((n,), np.uint8)
+    buf_ptrs = (ctypes.c_char_p * n)(*bufs)
+    lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
+    crop_arr = (ctypes.c_int * (4 * n))(
+        *[int(v) for c in crops for v in c])
+    flip_arr = np.ascontiguousarray(np.asarray(flips, np.uint8))
+    sub_arr = np.ascontiguousarray(np.asarray(sub, np.float32))
+    lib.dtf_jpeg_decode_crop_resize_batch(
+        buf_ptrs, lens, n, crop_arr,
+        flip_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out_h, out_w,
+        sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        num_threads)
+    return out, statuses == 0
