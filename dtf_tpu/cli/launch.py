@@ -54,7 +54,7 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
               log_dir: str, devices_per_process: Optional[int],
               stagger_s: float = 0.0,
               heartbeat_timeout: Optional[float] = None,
-              attempt: int = 0) -> int:
+              attempt: int = 0, startup_grace: float = 300.0) -> int:
     os.makedirs(log_dir, exist_ok=True)
     procs = []  # (rank, Popen)
     logs = []
@@ -62,6 +62,7 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
     # hang watchdog state: last time each rank's log grew
     sizes = [0] * num_processes
     last_beat = [0.0] * num_processes
+    spawned = [0.0] * num_processes
     # restart attempts keep earlier logs (the first failure is usually
     # the informative one): log0.log, then log0.retry1.log, ...
     suffix = f".retry{attempt}" if attempt else ""
@@ -75,7 +76,7 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                                    devices_per_process),
                 stdout=f, stderr=subprocess.STDOUT)
             procs.append((rank, p))
-            last_beat[rank] = time.monotonic()  # budget starts at spawn
+            last_beat[rank] = spawned[rank] = time.monotonic()
             if stagger_s:
                 time.sleep(stagger_s)  # run.sh's 1 s stagger, now optional
         while procs:
@@ -97,7 +98,12 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                         if sz != sizes[rank]:
                             sizes[rank] = sz
                             last_beat[rank] = now
-                        elif now - last_beat[rank] > heartbeat_timeout:
+                        elif (now - last_beat[rank] > heartbeat_timeout
+                              # a rank in first XLA compile / checkpoint
+                              # restore legitimately logs nothing for
+                              # minutes — give every rank a startup
+                              # grace before the heartbeat rule applies
+                              and now - spawned[rank] > startup_grace):
                             print(f"rank {rank} heartbeat lost "
                                   f"({heartbeat_timeout:.0f}s without log "
                                   f"output); killing", file=sys.stderr)
@@ -124,7 +130,8 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
 def launch_local(cmd: List[str], num_processes: int, coordinator: str,
                  log_dir: str, devices_per_process: Optional[int],
                  stagger_s: float = 0.0, max_restarts: int = 0,
-                 heartbeat_timeout: Optional[float] = None) -> int:
+                 heartbeat_timeout: Optional[float] = None,
+                 startup_grace: float = 300.0) -> int:
     """Run the job, optionally supervising it.
 
     ``max_restarts``: on any rank failing (or hanging, with
@@ -138,7 +145,7 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
     while True:
         rc = _run_once(cmd, num_processes, coordinator, log_dir,
                        devices_per_process, stagger_s, heartbeat_timeout,
-                       attempt=attempt)
+                       attempt=attempt, startup_grace=startup_grace)
         if rc == 0 or attempt >= max_restarts:
             return rc
         attempt += 1
@@ -183,6 +190,7 @@ def main(argv=None) -> int:
     execute = False
     max_restarts = 0
     heartbeat_timeout: Optional[float] = None
+    startup_grace: Optional[float] = None  # None → default 300 (local mode)
     i = 0
     while i < len(opts):
         o = opts[i]
@@ -203,6 +211,8 @@ def main(argv=None) -> int:
             max_restarts = int(opts[i + 1]); i += 2
         elif o == "--heartbeat_timeout":
             heartbeat_timeout = float(opts[i + 1]); i += 2
+        elif o == "--startup_grace":
+            startup_grace = float(opts[i + 1]); i += 2
         else:
             raise ValueError(f"unknown launcher option {o}")
 
@@ -211,10 +221,11 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--hosts runs one rank per host; --num_processes/"
                 "--devices_per_process are not supported with it")
-        if max_restarts or heartbeat_timeout:
+        if max_restarts or heartbeat_timeout or startup_grace is not None:
             raise ValueError(
-                "--max_restarts/--heartbeat_timeout supervise local "
-                "fan-out; for --hosts runs, supervise on each host")
+                "--max_restarts/--heartbeat_timeout/--startup_grace "
+                "supervise local fan-out; for --hosts runs, supervise "
+                "on each host")
         if coordinator == "localhost:12346":
             coordinator = f"{hosts[0]}:12346"
         lines = cluster_commands(cmd, hosts, coordinator, log_dir,
@@ -234,7 +245,9 @@ def main(argv=None) -> int:
         return rc
     return launch_local(cmd, num_processes, coordinator, log_dir,
                         devices_per_process, max_restarts=max_restarts,
-                        heartbeat_timeout=heartbeat_timeout)
+                        heartbeat_timeout=heartbeat_timeout,
+                        startup_grace=(300.0 if startup_grace is None
+                                       else startup_grace))
 
 
 if __name__ == "__main__":

@@ -53,25 +53,44 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
             f"process count ({jax.process_count()})")
     host_batch = global_batch // jax.process_count()
     if cfg.use_synthetic_data or not cfg.data_dir:
-        return (
+        fns = (
             lambda: synthetic_input_fn(spec, True, host_batch, cfg.seed),
             lambda: synthetic_input_fn(spec, False, host_batch, cfg.seed + 1),
         )
-    if spec.name == "cifar10":
+    elif spec.name == "cifar10":
         from dtf_tpu.data.cifar import cifar_input_fn
-        return (
+        fns = (
             lambda: cifar_input_fn(cfg.data_dir, True, host_batch, seed=cfg.seed),
-            lambda: cifar_input_fn(cfg.data_dir, False, host_batch),
+            lambda: cifar_input_fn(cfg.data_dir, False, host_batch,
+                                   drop_remainder=cfg.drop_remainder),
         )
-    if spec.name == "imagenet":
+    elif spec.name == "imagenet":
         from dtf_tpu.data.imagenet import imagenet_input_fn
-        return (
+        fns = (
             lambda: imagenet_input_fn(cfg.data_dir, True, host_batch,
                                       seed=cfg.seed,
                                       num_threads=cfg.datasets_num_private_threads),
-            lambda: imagenet_input_fn(cfg.data_dir, False, host_batch),
+            lambda: imagenet_input_fn(cfg.data_dir, False, host_batch,
+                                      drop_remainder=cfg.drop_remainder),
         )
-    raise ValueError(f"no input pipeline for dataset {spec.name!r}")
+    else:
+        raise ValueError(f"no input pipeline for dataset {spec.name!r}")
+    if cfg.data_format == "channels_first" and not spec.is_sequence:
+        # --data_format parity (resnet_cifar_main.py:94-98): batches flow
+        # NCHW from here on; the compiled steps transpose back to NHWC
+        fns = tuple(_channels_first_factory(fn) for fn in fns)
+    return fns
+
+
+def _channels_first_factory(fn):
+    import numpy as np
+
+    def wrapped():
+        for batch in fn():
+            images = np.ascontiguousarray(
+                np.asarray(batch[0]).transpose(0, 3, 1, 2))
+            yield (images,) + tuple(batch[1:])
+    return wrapped
 
 
 def run(cfg: Config) -> dict:
@@ -115,18 +134,19 @@ def run(cfg: Config) -> dict:
     # stacked-block family
     pipe_axis = (MODEL_AXIS if is_pipeline and cfg.model_parallelism > 1
                  else None)
-    # experts ride the batch-splitting axis (classic DeepSpeed-MoE/GShard
-    # expert-parallel placement); harmless when that axis has size 1
-    expert_axis = DATA_AXIS if is_moe else None
+    # experts ride the batch-splitting axis by default (classic
+    # DeepSpeed-MoE/GShard placement — all_to_all token exchange);
+    # --model_parallelism with a MoE family instead places them on the
+    # 'model' axis (group size decoupled from dp; batch replicated
+    # across it, partial-output psum — models/moe.py docstring)
+    expert_axis = None
+    expert_on_model = is_moe and cfg.model_parallelism > 1
+    if is_moe:
+        expert_axis = MODEL_AXIS if expert_on_model else DATA_AXIS
     if is_pipeline and cfg.seq_parallelism > 1:
         raise ValueError(
             "pipeline_transformer does not compose with seq_parallelism; "
             "use the plain transformer for ring attention")
-    if is_moe and cfg.model_parallelism > 1:
-        raise ValueError(
-            "moe_transformer does not use the 'model' axis (experts "
-            "already shard the ff computation over 'data'); drop "
-            "--model_parallelism")
     # None flags defer to the model preset's own defaults (the registry
     # partials, e.g. moe_transformer_small's 4 experts)
     model_kw = {}
@@ -136,8 +156,21 @@ def run(cfg: Config) -> dict:
             capacity_factor=cfg.moe_capacity_factor,
             aux_weight=cfg.moe_aux_weight,
             router_top_k=cfg.moe_top_k).items() if v is not None}
-    elif is_pipeline and cfg.num_microbatches is not None:
-        model_kw = dict(num_microbatches=cfg.num_microbatches)
+        if expert_on_model:
+            model_kw["expert_axis_along_batch"] = False
+    elif is_pipeline:
+        if cfg.num_microbatches is not None:
+            model_kw = dict(num_microbatches=cfg.num_microbatches)
+        else:
+            # auto-scale the GPipe schedule: bubble fraction is
+            # (pp-1)/(M+pp-1), so target M = 4·pp (≤20% bubble) and
+            # halve until it divides the per-shard batch
+            pp = max(cfg.model_parallelism, 1)
+            per_shard = global_batch // rt.num_replicas
+            m = 4 * pp
+            while m > 1 and per_shard % m:
+                m //= 2
+            model_kw = dict(num_microbatches=max(m, 1))
     if cfg.remat:
         if not model_name.startswith(
                 ("transformer", "moe_transformer", "pipeline_transformer")):
@@ -206,6 +239,13 @@ def run(cfg: Config) -> dict:
             restored = ckpt_cb.ckpt.restore(state, sharding=state_shardings)
             if restored is not None:
                 state = restored
+            elif cfg.eval_only:
+                # evaluating random init as if it were a checkpoint would
+                # silently report garbage — fail instead
+                raise FileNotFoundError(
+                    f"--eval_only --resume: no checkpoint found under "
+                    f"{cfg.model_dir}/checkpoints; point --model_dir at a "
+                    f"trained run")
             else:
                 log.warning(
                     "--resume: no checkpoint found under %s/checkpoints — "

@@ -65,9 +65,18 @@ class Config:
     batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)
 
     # --- image / data ---
-    data_format: str = "channels_last"  # TPU/XLA prefers NHWC; channels_first accepted+transposed
+    # --data_format (reference resnet_cifar_main.py:94-98): channels_first
+    # means batches are fed NCHW; the train/eval steps transpose to NHWC
+    # (compute is always NHWC — the MXU layout)
+    data_format: str = "channels_last"
     use_synthetic_data: bool = False    # --use_synthetic_data (common.py:311-359)
-    drop_remainder: bool = True         # static shapes for XLA (imagenet_main.py:143-145)
+    # Eval partial-batch handling.  False (default): eval pipelines pad
+    # the final partial batch and mask the padding, so eval covers the
+    # reference's exact full set (imagenet_preprocessing.py:259-323) with
+    # static shapes.  True: drop it (every eval batch full — benchmark
+    # purity).  Training always drops the remainder for static shapes
+    # (imagenet_main.py:143-145 XLA parity).
+    drop_remainder: bool = False
     image_bytes_as_serving_input: bool = False  # compat
 
     # --- keras-flags extras (common.py:248-309) ---
@@ -80,7 +89,9 @@ class Config:
     enable_tensorboard: bool = False    # --enable_tensorboard (common.py:187-190)
     train_steps: Optional[int] = None   # --train_steps cap (common.py)
     profile_steps: Optional[str] = None  # --profile_steps "start,stop" (common.py:289-296)
-    enable_get_next_as_optional: bool = False  # partial-batch handling compat
+    # partial-batch handling (reference resnet_cifar_main.py:108-141):
+    # True forces drop_remainder=False (eval covers the partial batch)
+    enable_get_next_as_optional: bool = False
     log_steps: int = 100                # --log_steps for BenchmarkMetric cadence
     skip_checkpoint: bool = False       # rank-0 checkpoints off (horovod mains default on)
     resume: bool = False                # restore latest checkpoint from model_dir
@@ -151,6 +162,14 @@ class Config:
     verbose: int = 2                    # keras fit verbose parity (rank-gated)
 
     def __post_init__(self):
+        if self.data_format not in ("channels_last", "channels_first"):
+            raise ValueError(
+                f"unknown data_format {self.data_format!r}; choose "
+                f"channels_last or channels_first")
+        if self.enable_get_next_as_optional and self.drop_remainder:
+            # reference semantics: get_next_as_optional exists to handle
+            # the partial final batch — forcing drop would contradict it
+            self.drop_remainder = False
         if self.distribution_strategy not in STRATEGIES:
             raise ValueError(
                 f"unknown distribution_strategy {self.distribution_strategy!r}; "
@@ -185,6 +204,11 @@ class Config:
                     f"got {self.clip_grad_norm}")
         if self.eval_only and self.skip_eval:
             raise ValueError("--eval_only contradicts --skip_eval")
+        if self.stop_threshold is not None and not self.report_accuracy_metrics:
+            raise ValueError(
+                "--stop_threshold needs eval top-1, which "
+                "--report_accuracy_metrics false disables — early "
+                "stopping would silently never fire")
         if self.moe_top_k is not None and self.moe_top_k < 1:
             raise ValueError(f"moe_top_k must be >= 1, got {self.moe_top_k}")
         if self.eval_only and not self.resume:

@@ -23,3 +23,16 @@ def partition_specs(params, rule: Callable):
         return rule(keys, last, leaf)
 
     return jax.tree_util.tree_map_with_path(wrap, params)
+
+
+def spec_axes(spec) -> set:
+    """Mesh axis names a PartitionSpec shards over (the one shared
+    implementation — loop/registry/consumers import this)."""
+    axes: set = set()
+    if spec is None:
+        return axes
+    for part in spec:
+        if part is None:
+            continue
+        axes.update(part if isinstance(part, (tuple, list)) else (part,))
+    return axes

@@ -95,17 +95,56 @@ def build_model(name: str, num_classes: int | None = None,
     return module, l2
 
 
-def l2_weight_penalty(params, l2_weight: float) -> jax.Array:
+def l2_weight_penalty(params, l2_weight: float, param_specs=None
+                      ) -> jax.Array:
     """Keras-parity L2 term: l2 * sum(w²) over conv/dense kernels and the
     classifier bias.  Note Keras `regularizers.l2(l)` is `l * sum(w²)`
-    (no 0.5 factor)."""
+    (no 0.5 factor).
+
+    With ``param_specs`` (a PartitionSpec tree matching ``params``, for
+    model-sharded runs inside shard_map), each sharded leaf's local
+    sum-of-squares is summed over its sharding axes with `tp_psum` (sum
+    forward, identity backward), so the penalty — and its gradient on
+    each local shard — matches the unsharded model exactly.  Without it,
+    a TP/EP/PP-sharded kernel would be silently under-counted.
+
+    Penalized leaves sharded over a BATCH axis ('data'/'seq') are
+    rejected: the trainer's gradient reduction divides such leaves'
+    grads by the axis size (the all_to_all-transpose convention), which
+    would scale the tp_psum L2 gradient down by the same factor.  No
+    model family hits this (expert weights are named w1/w2, outside the
+    penalize rule), so it is a guard, not a capability."""
     if not l2_weight:
         return jnp.zeros((), jnp.float32)
+    spec_leaves = None
+    if param_specs is not None:
+        from jax.sharding import PartitionSpec
+        spec_leaves = [
+            s for _, s in jax.tree_util.tree_leaves_with_path(
+                param_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))]
     total = jnp.zeros((), jnp.float32)
-    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+    for i, (path, leaf) in enumerate(
+            jax.tree_util.tree_leaves_with_path(params)):
         keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
         last = keys[-1] if keys else ""
         penalized = last == "kernel" or (last == "bias" and "fc" in keys)
-        if penalized:
-            total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        if not penalized:
+            continue
+        ss = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        if spec_leaves is not None:
+            from dtf_tpu.models.partition import spec_axes
+            axes = spec_axes(spec_leaves[i])
+            batch_sharded = axes & {"data", "seq"}
+            if batch_sharded:
+                raise ValueError(
+                    f"L2-penalized leaf {'/'.join(keys)} is sharded over "
+                    f"batch axes {sorted(batch_sharded)}; the L2 gradient "
+                    f"would be divided by the axis size in gradient "
+                    f"reduction — unsupported")
+            if axes:
+                from dtf_tpu.parallel.collectives import tp_psum
+                for ax in sorted(axes):
+                    ss = tp_psum(ss, ax)
+        total = total + ss
     return l2_weight * total

@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dtf_tpu.config import Config
 from dtf_tpu.data.base import DatasetSpec
+from dtf_tpu.models.partition import spec_axes as _spec_axes
 from dtf_tpu.models.registry import l2_weight_penalty
 from dtf_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
                                   MeshRuntime)
@@ -78,15 +79,36 @@ def _pad_flat(p, nd: int):
     return flat
 
 
+def _zero_opt_leaf_spec(spec):
+    """Optimizer-state PartitionSpec for one param leaf under ZeRO-1.
+
+    Leaves already sharded over 'data' (MoE experts riding the batch
+    axis) keep locally-shaped state — each data shard already holds
+    distinct experts, so there is nothing left to slice.  Every other
+    leaf's state is a padded flat buffer sliced over 'data' — composed
+    with 'model' when the param itself is TP/PP-sharded there (each
+    (data, model) coordinate owns one slice of the local shard)."""
+    axes = _spec_axes(spec)
+    if DATA_AXIS in axes:
+        return spec
+    if MODEL_AXIS in axes:
+        return P((DATA_AXIS, MODEL_AXIS))
+    return P(DATA_AXIS)
+
+
+def per_example_cross_entropy(logits, labels):
+    """Un-reduced CE with integer labels — one value per position."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
 def cross_entropy(logits, labels):
     """Mean CE with integer labels; numerically identical to the
     reference's categorical CE over one-hot labels."""
-    return jnp.mean(
-        optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+    return jnp.mean(per_example_cross_entropy(logits, labels))
 
 
-def sharded_cross_entropy(local_logits, labels, axis: str):
-    """Mean CE over vocab-sharded logits (Megatron's vocab-parallel
+def sharded_per_example_cross_entropy(local_logits, labels, axis: str):
+    """Un-reduced CE over vocab-sharded logits (Megatron's vocab-parallel
     softmax): a collective logsumexp over the model axis — the full
     vocab dimension never materializes on one shard.
 
@@ -108,22 +130,31 @@ def sharded_cross_entropy(local_logits, labels, axis: str):
     safe = jnp.clip(local_label, 0, vloc - 1)
     picked = jnp.take_along_axis(local_logits, safe[..., None], -1)[..., 0]
     correct = tp_psum(jnp.where(in_range, picked, 0.0), axis)
-    return jnp.mean(lse - correct)
+    return lse - correct
+
+
+def sharded_cross_entropy(local_logits, labels, axis: str):
+    """Mean CE over vocab-sharded logits."""
+    return jnp.mean(
+        sharded_per_example_cross_entropy(local_logits, labels, axis))
 
 
 def sharded_argmax(local_logits, axis: str):
     """Global argmax over vocab-sharded logits (metrics only — not
-    differentiated).  Ties resolve to the highest global index."""
+    differentiated).  Ties resolve to the lowest global index, matching
+    jnp.argmax on the equivalent unsharded logits (within a shard
+    jnp.argmax already picks the lowest; across shards the pmin does)."""
     # callers may sit inside a differentiated function (train-step
-    # metrics) and pmax has no differentiation rule
+    # metrics) and pmax/pmin have no differentiation rule
     local_logits = lax.stop_gradient(local_logits)
     vloc = local_logits.shape[-1]
     offset = lax.axis_index(axis) * vloc
     local_max = jnp.max(local_logits, -1)
     local_arg = jnp.argmax(local_logits, -1) + offset
     best = lax.pmax(local_max, axis)
-    cand = jnp.where(local_max == best, local_arg, -1)
-    return lax.pmax(cand, axis)
+    sentinel = jnp.iinfo(local_arg.dtype).max
+    cand = jnp.where(local_max == best, local_arg, sentinel)
+    return lax.pmin(cand, axis)
 
 
 class Trainer:
@@ -144,13 +175,9 @@ class Trainer:
         self.vocab_axis = vocab_axis
         # tensor parallelism: fn(params) -> PartitionSpec tree sharding
         # params over the 'model' axis (e.g. transformer.
-        # param_partition_specs).  The L2 penalty sums over param leaves
-        # and would silently under-count sharded kernels.
+        # param_partition_specs).  The L2 penalty is sharding-aware
+        # (l2_weight_penalty psums each sharded leaf over its axes).
         self.param_spec_fn = param_spec_fn
-        if param_spec_fn is not None and l2_weight:
-            raise ValueError(
-                "tensor-parallel param sharding does not support the L2 "
-                "penalty (sharded kernels would be under-counted)")
 
         # ---- epoch math (SURVEY §3.3/3.4 steps//size semantics) ----
         # cfg.batch_size is the GLOBAL batch. In horovod/parameter_server
@@ -185,7 +212,14 @@ class Trainer:
             # reference mains: train_steps caps to 1 epoch of that length
             self.steps_per_epoch = min(cfg.train_steps, self.steps_per_epoch)
             self.train_epochs = 1
-        self.eval_steps = spec.num_eval // self.global_batch
+        # --data_format: the reference honors channels_first by setting
+        # the Keras image data format (resnet_cifar_main.py:94-98).
+        # Here NCHW batches are accepted and transposed to NHWC inside
+        # the compiled step (free: XLA folds the transpose into the
+        # first conv's layout assignment); compute stays NHWC for the
+        # MXU either way.
+        self.channels_first = (cfg.data_format == "channels_first"
+                               and not spec.is_sequence)
 
         if schedule is not None:
             self.schedule = schedule
@@ -207,13 +241,11 @@ class Trainer:
         # ZeRO-1 weight-update sharding (PAPERS.md: Xu et al. 2020):
         # optimizer state lives sliced over the data axis, gradients
         # reduce-scatter instead of all-reduce, updated slices
-        # all-gather back.  Orthogonal model sharding (TP/EP/PP specs)
-        # is not composed with it yet.
+        # all-gather back.  Composes with TP/EP/PP param sharding:
+        # model-sharded leaves slice their *local* shard over 'data'
+        # (state spec ('data','model')); expert leaves riding 'data'
+        # keep locally-shaped state (_zero_opt_leaf_spec).
         self.zero = bool(cfg.optimizer_sharding)
-        if self.zero and self.param_spec_fn is not None:
-            raise ValueError(
-                "--optimizer_sharding composes with pure data parallelism "
-                "only (not TP/EP/PP param sharding) for now")
 
         if self.param_spec_fn is None and not self.zero:
             self._build_steps()
@@ -227,6 +259,8 @@ class Trainer:
         every process initializes from the same seed, so params are
         identical without a broadcast."""
         images = jnp.asarray(sample_batch[0][:1])
+        if self.channels_first:
+            images = jnp.transpose(images, (0, 2, 3, 1))
         # a seq- or model-sharded module calls collectives and can only
         # run inside shard_map; param *shapes* don't depend on those
         # axes (TP shards arrive by sharding the full arrays), so init
@@ -243,21 +277,42 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         if self.zero:
-            # optimizer state over PADDED FLAT leaves [nd·k]; sharding
-            # dim 0 over 'data' leaves each shard its [k] slice.  Init
-            # under jit with sharded out_shardings so the full state
-            # never materializes on one device (the transient spike
-            # would OOM exactly the model sizes this feature targets)
+            # optimizer state over PADDED FLAT leaves [nd·k] (per
+            # (data, model) coordinate when the param is model-sharded;
+            # locally-shaped for expert leaves — _zero_opt_leaf_spec).
+            # Init under jit with sharded out_shardings so the full
+            # state never materializes on one device (the transient
+            # spike would OOM exactly the model sizes this targets)
             from dtf_tpu.train.optimizer import opt_state_specs
+            is_p = lambda x: isinstance(x, P)
             nd = self.rt.mesh.shape[DATA_AXIS]
-            opt_pspecs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
-                                                params)
+            mesh_shape = dict(self.rt.mesh.shape)
+            pspecs = (self.param_spec_fn(params)
+                      if self.param_spec_fn is not None
+                      else jax.tree_util.tree_map(lambda _: P(), params))
+            opt_pspecs = jax.tree_util.tree_map(_zero_opt_leaf_spec,
+                                                pspecs, is_leaf=is_p)
+
+            def proto_leaf(spec, p):
+                axes = _spec_axes(spec)
+                if DATA_AXIS in axes:
+                    return jax.ShapeDtypeStruct(p.shape, p.dtype)
+                msz = 1
+                for a in axes:
+                    msz *= mesh_shape[a]
+                k = -(-(p.size // msz) // nd)
+                return jax.ShapeDtypeStruct((nd * msz * k,), p.dtype)
+
+            protos = jax.tree_util.tree_map(proto_leaf, pspecs, params,
+                                            is_leaf=is_p)
             ospecs = opt_state_specs(self.cfg.optimizer, opt_pspecs, P())
             oshard = jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.rt.mesh, s), ospecs,
-                is_leaf=lambda x: isinstance(x, P))
-            opt_state = jax.jit(self.tx.init, out_shardings=oshard)(
-                jax.tree_util.tree_map(lambda p: _pad_flat(p, nd), params))
+                is_leaf=is_p)
+            opt_state = jax.jit(
+                lambda: self.tx.init(jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), protos)),
+                out_shardings=oshard)()
         else:
             opt_state = self.tx.init(params)
         state = TrainState(
@@ -268,7 +323,8 @@ class Trainer:
             good_steps=(jnp.zeros((), jnp.int32)
                         if self.dynamic_scale else None))
         if self.zero:
-            state_specs = self._make_zero_state_specs(state)
+            state_specs = self._make_zero_state_specs(state, pspecs,
+                                                      opt_pspecs)
             self._build_steps(state_specs)
             shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.rt.mesh, s), state_specs,
@@ -286,14 +342,13 @@ class Trainer:
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(state, shardings)
 
-    def _make_zero_state_specs(self, state: TrainState):
+    def _make_zero_state_specs(self, state: TrainState, pspecs,
+                               opt_pspecs):
         from dtf_tpu.train.optimizer import opt_state_specs
         rep = P()
-        opt_pspecs = jax.tree_util.tree_map(lambda _: P(DATA_AXIS),
-                                            state.params)
         return TrainState(
             step=rep,
-            params=jax.tree_util.tree_map(lambda _: rep, state.params),
+            params=pspecs,
             batch_stats=jax.tree_util.tree_map(lambda _: rep,
                                                state.batch_stats),
             opt_state=opt_state_specs(self.cfg.optimizer, opt_pspecs, rep),
@@ -358,15 +413,6 @@ class Trainer:
         param_specs = None if state_specs is None else state_specs.params
         mesh_shape = dict(mesh.shape)
 
-        def _spec_axes(spec):
-            axes = set()
-            for part in spec:
-                if part is None:
-                    continue
-                axes.update(part if isinstance(part, (tuple, list))
-                            else (part,))
-            return axes
-
         def reduce_grads(grads):
             if param_specs is None:
                 return jax.lax.pmean(grads, reduce_axes)
@@ -422,22 +468,40 @@ class Trainer:
         dynamic = self.dynamic_scale
         vocab_axis = self.vocab_axis
         zero = self.zero
+        channels_first = self.channels_first
+        # --report_accuracy_metrics false (reference common.py:277-278):
+        # drop the in-step accuracy compute entirely for benchmark purity
+        report_acc = self.cfg.report_accuracy_metrics
 
         def compute_ce(logits, labels):
             if vocab_axis is not None:
                 return sharded_cross_entropy(logits, labels, vocab_axis)
             return cross_entropy(logits, labels)
 
-        def compute_acc(logits, labels):
+        def compute_per_example_ce(logits, labels):
+            if vocab_axis is not None:
+                return sharded_per_example_cross_entropy(
+                    logits, labels, vocab_axis)
+            return per_example_cross_entropy(logits, labels)
+
+        def compute_correct(logits, labels):
+            """Per-position 0/1 correctness, float32."""
             if vocab_axis is not None:
                 preds = sharded_argmax(logits, vocab_axis)
             else:
                 preds = jnp.argmax(logits, -1)
-            return jnp.mean((preds == labels).astype(jnp.float32))
+            return (preds == labels).astype(jnp.float32)
+
+        def compute_acc(logits, labels):
+            if not report_acc:
+                return jnp.zeros((), jnp.float32)
+            return jnp.mean(compute_correct(logits, labels))
 
         accum = self.grad_accum
 
         def local_train_step(state: TrainState, images, labels):
+            if channels_first:
+                images = jnp.transpose(images, (0, 2, 3, 1))
             scale = state.loss_scale if dynamic else loss_scale
 
             def grad_of_chunk(params, batch_stats, imgs, lbls):
@@ -445,7 +509,7 @@ class Trainer:
                     logits, new_stats, aux = self._apply(
                         p, batch_stats, imgs, train=True)
                     ce = compute_ce(logits, lbls)
-                    loss = ce + l2_weight_penalty(p, l2w) + aux
+                    loss = ce + l2_weight_penalty(p, l2w, param_specs) + aux
                     return loss * scale, (loss, compute_acc(logits, lbls),
                                           new_stats)
                 return jax.grad(loss_fn, has_aux=True)(params)
@@ -489,45 +553,79 @@ class Trainer:
                 # ZeRO-1 weight-update sharding: the gradient all-reduce
                 # becomes a reduce-scatter (same ICI volume), each data
                 # shard updates its 1/nd slice with its 1/nd optimizer
-                # state, and the updated slices all-gather back
+                # state, and the updated slices all-gather back.
+                # Composed with model sharding: a TP/PP leaf slices its
+                # LOCAL shard (scatter/gather stay pure-'data'
+                # collectives); an expert leaf riding 'data' updates in
+                # place (its grads were already summed by the
+                # all_to_all transpose — divide to the global-mean
+                # convention like reduce_grads does).
                 nd = mesh_shape[DATA_AXIS]
                 idx = lax.axis_index(DATA_AXIS)
+                is_p = lambda x: isinstance(x, P)
+                zspecs = param_specs
 
-                def scatter(g):
+                def scatter(spec, g):
+                    sharded = _spec_axes(spec)
+                    if DATA_AXIS in sharded:
+                        axes = tuple(a for a in reduce_axes
+                                     if a not in sharded)
+                        if axes:
+                            g = jax.lax.pmean(g, axes)
+                        denom = 1
+                        for a in reduce_axes:
+                            if a in sharded:
+                                denom *= mesh_shape[a]
+                        return (g / denom).astype(jnp.float32)
                     flat = _pad_flat(g.astype(jnp.float32), nd)
                     s = lax.psum_scatter(flat, DATA_AXIS,
                                          scatter_dimension=0,
                                          tiled=True) / nd
                     return lax.pmean(s, SEQ_AXIS)
 
-                g_slices = jax.tree_util.tree_map(scatter, grads)
+                g_slices = jax.tree_util.tree_map(scatter, zspecs, grads,
+                                                  is_leaf=is_p)
                 if clip_norm:
-                    sumsq = sum(
-                        lax.psum(jnp.sum(jnp.square(s)), DATA_AXIS)
-                        for s in jax.tree_util.tree_leaves(g_slices))
+                    def slice_sumsq(spec, s):
+                        # each slice holds distinct elements across
+                        # 'data' (and 'model' for model-sharded leaves)
+                        axes = {DATA_AXIS} | (_spec_axes(spec)
+                                              & {MODEL_AXIS})
+                        return lax.psum(jnp.sum(jnp.square(s)),
+                                        tuple(sorted(axes)))
+                    parts = jax.tree_util.tree_map(slice_sumsq, zspecs,
+                                                   g_slices, is_leaf=is_p)
+                    sumsq = sum(jax.tree_util.tree_leaves(parts))
                     norm = jnp.sqrt(sumsq)
                     factor = jnp.minimum(
                         1.0, clip_norm / jnp.maximum(norm, 1e-12))
                     g_slices = jax.tree_util.tree_map(
                         lambda s: s * factor, g_slices)
 
-                def pslice(p):
+                def pslice(spec, p):
+                    if DATA_AXIS in _spec_axes(spec):
+                        return p
                     flat = _pad_flat(p, nd)
                     k = flat.shape[0] // nd
                     return lax.dynamic_slice_in_dim(flat, idx * k, k)
 
-                p_slices = jax.tree_util.tree_map(pslice, state.params)
+                p_slices = jax.tree_util.tree_map(pslice, zspecs,
+                                                  state.params,
+                                                  is_leaf=is_p)
                 updates, new_opt = self.tx.update(
                     g_slices, state.opt_state, p_slices, step=state.step)
                 new_slices = optax.apply_updates(p_slices, updates)
 
-                def gather(ns, p):
+                def gather(spec, ns, p):
+                    if DATA_AXIS in _spec_axes(spec):
+                        return ns.astype(p.dtype)
                     full = lax.all_gather(ns, DATA_AXIS, axis=0,
                                           tiled=True)
                     return full[:p.size].reshape(p.shape).astype(p.dtype)
 
-                params = jax.tree_util.tree_map(gather, new_slices,
-                                                state.params)
+                params = jax.tree_util.tree_map(gather, zspecs,
+                                                new_slices, state.params,
+                                                is_leaf=is_p)
                 grads = g_slices  # the dynamic-scale finite check below
             else:
                 # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
@@ -571,9 +669,10 @@ class Trainer:
                                      state.good_steps + 1, 0)
             metrics = {
                 "loss": jax.lax.pmean(loss, reduce_axes),
-                "accuracy": jax.lax.pmean(acc, reduce_axes),
                 "learning_rate": self.schedule(state.step),
             }
+            if report_acc:
+                metrics["accuracy"] = jax.lax.pmean(acc, reduce_axes)
             if dynamic:
                 metrics["loss_scale"] = new_scale
             return TrainState(step=state.step + 1, params=params,
@@ -581,13 +680,28 @@ class Trainer:
                               loss_scale=new_scale,
                               good_steps=new_good), metrics
 
-        def local_eval_step(state: TrainState, images, labels):
+        def local_eval_step(state: TrainState, images, labels, mask):
+            """Masked sums, not batch means: eval pipelines pad the final
+            partial batch (shapes stay static for XLA) and flag padding
+            with mask=0, so eval covers exactly the real examples once —
+            the reference's full-set eval (imagenet_preprocessing.py:
+            259-323), which a drop-remainder loop silently under-covers.
+            Units: examples for vision, tokens for sequence data."""
+            if channels_first:
+                images = jnp.transpose(images, (0, 2, 3, 1))
             logits, _ = self._apply(state.params, state.batch_stats,
                                     images, train=False)
-            loss = compute_ce(logits, labels)
-            acc = compute_acc(logits, labels)
-            return (jax.lax.pmean(loss, reduce_axes),
-                    jax.lax.pmean(acc, reduce_axes))
+            per = compute_per_example_ce(logits, labels)  # [B] | [B,S/sp]
+            w = mask[:, None] * jnp.ones_like(per) if per.ndim == 2 else mask
+            loss_sum = lax.psum(jnp.sum(per * w), reduce_axes)
+            if report_acc:
+                correct = lax.psum(
+                    jnp.sum(compute_correct(logits, labels) * w),
+                    reduce_axes)
+            else:
+                correct = jnp.zeros((), jnp.float32)
+            count = lax.psum(jnp.sum(w), reduce_axes)
+            return loss_sum, correct, count
 
         # replicated prefix by default; a full per-leaf tree under TP
         state_spec = rep if state_specs is None else state_specs
@@ -597,10 +711,12 @@ class Trainer:
             in_specs=(state_spec, data_spec, data_spec),
             out_specs=(state_spec, rep),
             check_vma=False)
+        # the mask is per-example [B]: sharded over 'data' only, even
+        # when token data additionally shards dim 1 over 'seq'
         eval_sharded = jax.shard_map(
             local_eval_step, mesh=mesh,
-            in_specs=(state_spec, data_spec, data_spec),
-            out_specs=(rep, rep),
+            in_specs=(state_spec, data_spec, data_spec, P(DATA_AXIS)),
+            out_specs=(rep, rep, rep),
             check_vma=False)
 
         self.train_step = jax.jit(train_sharded, donate_argnums=(0,))
@@ -608,17 +724,33 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def evaluate(self, state: TrainState, eval_iter: Iterator):
-        losses, accs, n = [], [], 0
-        for images, labels in eval_iter:
-            batch = self.rt.shard_batch((images, labels))
-            loss, acc = self.eval_step(state, *batch)
-            losses.append(loss)
-            accs.append(acc)
-            n += 1
-        if not n:
+        """Weighted-exact eval: batches are (images, labels[, mask]);
+        a missing mask means every example is real.  Returns
+        (mean loss, top-1) over exactly the unmasked examples, or None
+        when the iterator is empty.  top-1 is None under
+        --report_accuracy_metrics false."""
+        loss_sums, correct_sums, counts = [], [], []
+        for batch in eval_iter:
+            if len(batch) == 2:
+                images, labels = batch
+                mask = np.ones((np.asarray(labels).shape[0],), np.float32)
+            else:
+                images, labels, mask = batch
+            sharded = self.rt.shard_batch((images, labels, mask))
+            ls, cs, n = self.eval_step(state, *sharded)
+            loss_sums.append(ls)
+            correct_sums.append(cs)
+            counts.append(n)
+        if not counts:
             return None
-        return (float(np.mean(jax.device_get(losses))),
-                float(np.mean(jax.device_get(accs))))
+        total = float(np.sum(jax.device_get(counts)))
+        if total == 0:
+            return None
+        loss = float(np.sum(jax.device_get(loss_sums))) / total
+        if not self.cfg.report_accuracy_metrics:
+            return (loss, None)
+        return (loss,
+                float(np.sum(jax.device_get(correct_sums))) / total)
 
     # ------------------------------------------------------------------
     def fit(self, state: TrainState, train_iter: Iterator,
@@ -676,26 +808,30 @@ class Trainer:
             # records per-epoch training metrics)
             m = jax.device_get(metrics)
             history["loss"].append(float(m["loss"]))
-            history[acc_key].append(float(m["accuracy"]))
+            if "accuracy" in m:
+                history[acc_key].append(float(m["accuracy"]))
             for cb in callbacks:
                 _call(cb, "on_epoch_end", epoch,
                       {"state": state, "history": history})
             if cfg.verbose and (jax.process_index() == 0):
-                log.info("epoch %d/%d: loss=%.4f top1=%.4f lr=%.5f",
+                log.info("epoch %d/%d: loss=%.4f top1=%s lr=%.5f",
                          epoch + 1, self.train_epochs, history["loss"][-1],
-                         history[acc_key][-1], float(m["learning_rate"]))
+                         ("%.4f" % m["accuracy"]) if "accuracy" in m
+                         else "n/a", float(m["learning_rate"]))
             run_eval = (not cfg.skip_eval and eval_iter_fn is not None and
                         ((epoch + 1) % cfg.epochs_between_evals == 0 or
                          epoch + 1 == self.train_epochs))
             if run_eval:
                 eval_output = self.evaluate(state, eval_iter_fn())
                 if eval_output and jax.process_index() == 0:
-                    log.info("eval: loss=%.4f top1=%.4f",
-                             eval_output[0], eval_output[1])
+                    log.info("eval: loss=%.4f top1=%s", eval_output[0],
+                             ("%.4f" % eval_output[1])
+                             if eval_output[1] is not None else "n/a")
                 # --stop_threshold parity (model_helpers.past_stop_threshold
                 # via flags_core.define_base): end training once eval top-1
                 # reaches the threshold
                 if (eval_output and cfg.stop_threshold is not None
+                        and eval_output[1] is not None
                         and eval_output[1] >= cfg.stop_threshold):
                     if jax.process_index() == 0:
                         log.info("stop_threshold %.4f reached (top1=%.4f) — "
@@ -710,7 +846,9 @@ class Trainer:
             eval_output = self.evaluate(state, eval_iter_fn())
             if eval_output and jax.process_index() == 0:
                 log.info("eval (resumed, no further training): loss=%.4f "
-                         "top1=%.4f", eval_output[0], eval_output[1])
+                         "top1=%s", eval_output[0],
+                         ("%.4f" % eval_output[1])
+                         if eval_output[1] is not None else "n/a")
         for cb in callbacks:
             _call(cb, "on_train_end", {"state": state, "history": history})
         if metrics is not None:

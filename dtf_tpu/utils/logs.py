@@ -54,7 +54,10 @@ class TimeHistory:
 
     def on_batch_begin(self, batch: int, logs=None):
         self.global_steps += 1
-        if self.global_steps == 1:
+        if self._step_start is None:
+            # first batch of this run — which on a resumed run is NOT
+            # global step 1 (r1 crashed here: now - None at the first
+            # BenchmarkMetric line after a checkpoint restore)
             self._step_start = time.time()
             self.timestamp_log.append(
                 BatchTimestamp(self.global_steps, self._step_start))
@@ -89,12 +92,13 @@ def build_stats(history: dict, eval_output, time_callback: Optional[TimeHistory]
     """
     stats: dict = {}
     if eval_output:
-        stats["accuracy_top_1"] = float(eval_output[1])
+        if eval_output[1] is not None:  # --report_accuracy_metrics false
+            stats["accuracy_top_1"] = float(eval_output[1])
         stats["eval_loss"] = float(eval_output[0])
     if history and history.get("loss"):
         stats["loss"] = float(history["loss"][-1])
         for key in ("categorical_accuracy", "sparse_categorical_accuracy"):
-            if key in history:
+            if history.get(key):
                 stats["training_accuracy_top_1"] = float(history[key][-1])
                 break
     if time_callback is not None:

@@ -1,0 +1,106 @@
+"""Real-data convergence smoke (VERDICT r1 #10).
+
+The environment has no network egress, so the genuine CIFAR-10 tarball
+cannot be fetched; instead a *learnable* 10-class dataset is written in
+the exact CIFAR binary wire format (1 label byte + 3072 CHW bytes,
+cifar_preprocessing.py:30-33) and driven through the full production
+path: binary record parse → pad-crop-flip augmentation →
+per-image standardization → sharded SPMD train loop → checkpoint →
+resume → full-coverage eval.  This is the evidence class the reference
+carries as logged cluster runs (README.md:255-291): loss goes down,
+accuracy goes well above chance, and a mid-run restore continues
+training rather than restarting it.
+"""
+
+import numpy as np
+import pytest
+
+from dtf_tpu.cli import run
+from dtf_tpu.config import Config
+from dtf_tpu.data import cifar
+
+NUM_CLASSES = 10
+TRAIN_N = 1280
+EVAL_N = 320
+
+
+@pytest.fixture(scope="module")
+def cifar_real_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cifar_conv")
+    d = tmp / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(42)
+    per_file = TRAIN_N // 5
+    # one shared pattern table: re-seed so train/eval share classes
+    patterns = np.random.default_rng(7).normal(128, 60,
+                                               (NUM_CLASSES, 32, 32, 3))
+
+    def write(name, n, rng):
+        labels = rng.integers(0, NUM_CLASSES, n)
+        imgs = patterns[labels] + rng.normal(0, 24, (n, 32, 32, 3))
+        imgs = np.clip(imgs, 0, 255).astype(np.uint8)
+        recs = np.zeros((n, cifar.RECORD_BYTES), np.uint8)
+        recs[:, 0] = labels
+        recs[:, 1:] = imgs.transpose(0, 3, 1, 2).reshape(n, -1)
+        (d / name).write_bytes(recs.tobytes())
+
+    for i in range(1, 6):
+        write(f"data_batch_{i}.bin", per_file, rng)
+    write("test_batch.bin", EVAL_N, rng)
+    return str(tmp)
+
+
+@pytest.fixture(autouse=True)
+def real_cardinalities(monkeypatch):
+    import dataclasses
+    import dtf_tpu.data.base as data_base
+    spec = dataclasses.replace(data_base.CIFAR10, num_train=TRAIN_N,
+                               num_eval=EVAL_N)
+    monkeypatch.setitem(data_base._SPECS, "cifar10", spec)
+
+
+def test_cifar_binary_convergence_and_resume(cifar_real_dir, tmp_path):
+    model_dir = str(tmp_path / "run")
+    common = dict(model="resnet20", dataset="cifar10",
+                  data_dir=cifar_real_dir, batch_size=64,
+                  model_dir=model_dir, log_steps=10, verbose=0,
+                  epochs_between_evals=20)  # eval at the final epoch only
+
+    # phase 1: four epochs (80 steps), checkpointed
+    stats1 = run(Config(**common, train_epochs=4))
+    assert np.isfinite(stats1["loss"])
+
+    # phase 2: resume mid-run for eight more (240 steps total — the
+    # loss elbow for this recipe sits near step 140)
+    stats2 = run(Config(**common, train_epochs=12, resume=True))
+
+    # loss decreased across the resumed run and training accuracy is far
+    # above the 10% chance level
+    assert stats2["loss"] < stats1["loss"]
+    assert stats2["training_accuracy_top_1"] > 0.55
+    # full-coverage eval runs (320 examples, batch 64 → exact).  No
+    # accuracy bar: eval uses BN *running* stats, and at decay 0.997
+    # they are only 0.997^240 ≈ 51% settled after 240 steps — the
+    # reference's own hyperparams make short-run eval meaningless.
+    # (Eval exactness itself is covered by tests/test_eval_exact.py.)
+    assert np.isfinite(stats2["eval_loss"])
+    assert 0.0 <= stats2["accuracy_top_1"] <= 1.0
+
+
+def test_resume_continues_not_restarts(cifar_real_dir, tmp_path):
+    """The resumed run starts at the checkpointed step, so the second
+    call trains 1 additional epoch, not 2 from scratch."""
+    import jax
+    model_dir = str(tmp_path / "resume_probe")
+    common = dict(model="resnet20", dataset="cifar10",
+                  data_dir=cifar_real_dir, batch_size=64,
+                  model_dir=model_dir, log_steps=10, verbose=0,
+                  skip_eval=True)
+    run(Config(**common, train_epochs=1))
+    stats = run(Config(**common, train_epochs=2, resume=True))
+    steps_per_epoch = TRAIN_N // 64
+    # the resumed run's timestamp log covers ONLY epoch-2 steps (a
+    # from-scratch 2-epoch run would log epoch-1 indices too)
+    ts = stats["step_timestamp_log"]
+    assert all(t.batch_index > steps_per_epoch for t in ts)
+    assert ts[-1].batch_index == 2 * steps_per_epoch

@@ -1,0 +1,246 @@
+"""Exact full-coverage eval + newly-wired flags (r2).
+
+Covers VERDICT r1 Missing #2/#3/#4 and Weak #4/#7:
+  - pad+mask eval covers exactly the full eval set once (reference
+    full-set eval, imagenet_preprocessing.py:259-323), sharded across
+    processes without duplicate decode work
+  - --drop_remainder / --enable_get_next_as_optional observable behavior
+  - --report_accuracy_metrics false drops the accuracy compute
+  - --data_format channels_first accepted + transposed (reference
+    resnet_cifar_main.py:94-98)
+"""
+
+import dataclasses
+import io
+
+import jax
+import numpy as np
+import pytest
+
+import dtf_tpu.data.base as data_base
+from dtf_tpu.config import Config
+from dtf_tpu.data import cifar, records
+from dtf_tpu.data.base import DatasetSpec
+from dtf_tpu.models import build_model
+from dtf_tpu.runtime.mesh import MeshRuntime, make_mesh
+from dtf_tpu.train import Trainer
+
+
+@pytest.fixture()
+def cifar_dir(tmp_path):
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for name, n in [("data_batch_1.bin", 20), ("data_batch_2.bin", 20),
+                    ("data_batch_3.bin", 20), ("data_batch_4.bin", 20),
+                    ("data_batch_5.bin", 20), ("test_batch.bin", 30)]:
+        recs = np.zeros((n, cifar.RECORD_BYTES), np.uint8)
+        recs[:, 0] = rng.integers(0, 10, n)
+        recs[:, 1:] = rng.integers(0, 256, (n, 3072))
+        (d / name).write_bytes(recs.tobytes())
+    return str(tmp_path)
+
+
+# --- pipeline-level coverage -------------------------------------------
+
+def test_cifar_padded_eval_full_coverage(cifar_dir):
+    """30 eval examples, batch 8 → 4 masked batches covering all 30."""
+    batches = list(cifar.cifar_input_fn(cifar_dir, False, 8, process_id=0,
+                                        process_count=1,
+                                        drop_remainder=False))
+    assert len(batches) == 4
+    assert all(len(b) == 3 for b in batches)
+    masks = np.concatenate([b[2] for b in batches])
+    assert masks.sum() == 30
+    # unmasked examples reproduce the full standardized set, in order
+    images, labels = cifar.load_records(
+        cifar.get_filenames(False, cifar_dir))
+    got_imgs = np.concatenate([b[0] for b in batches])[masks == 1]
+    got_lbls = np.concatenate([b[1] for b in batches])[masks == 1]
+    np.testing.assert_array_equal(got_lbls, labels)
+    np.testing.assert_allclose(got_imgs, cifar.standardize(images),
+                               rtol=1e-6)
+
+
+def test_cifar_padded_eval_sharded_exactly_once(cifar_dir):
+    """Two processes: same batch count (collective alignment), disjoint
+    examples, union = the full test set exactly once."""
+    per_proc = [list(cifar.cifar_input_fn(cifar_dir, False, 4,
+                                          process_id=p, process_count=2,
+                                          drop_remainder=False))
+                for p in (0, 1)]
+    assert len(per_proc[0]) == len(per_proc[1]) == 4  # ceil(ceil(30/2)/4)
+    seen = []
+    for batches in per_proc:
+        m = np.concatenate([b[2] for b in batches])
+        lb = np.concatenate([b[1] for b in batches])
+        seen.append(lb[m == 1])
+    assert len(seen[0]) + len(seen[1]) == 30
+    _, labels = cifar.load_records(cifar.get_filenames(False, cifar_dir))
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(seen)), np.sort(labels))
+
+
+def test_cifar_eval_drop_remainder_unchanged(cifar_dir):
+    batches = list(cifar.cifar_input_fn(cifar_dir, False, 8, process_id=0,
+                                        process_count=1,
+                                        drop_remainder=True))
+    assert len(batches) == 3  # 30 // 8, 2-tuples
+    assert all(len(b) == 2 for b in batches)
+
+
+def test_count_tfrecord_records(tmp_path):
+    path = str(tmp_path / "x.tfrecord")
+    payloads = [b"a" * n for n in (0, 1, 5000, 37)]
+    records.write_tfrecord_file(path, payloads)
+    assert records.count_tfrecord_records(path) == 4
+    with open(path, "ab") as f:
+        f.write(b"\x99" * 5)  # truncated trailing record
+    with pytest.raises(IOError):
+        records.count_tfrecord_records(path)
+
+
+def test_imagenet_padded_eval_coverage(tmp_path):
+    from PIL import Image
+    from dtf_tpu.data import imagenet
+    rng = np.random.default_rng(0)
+    labels_written = []
+    for shard in range(2):
+        recs = []
+        for i in range(6):
+            arr = rng.integers(0, 256, (48, 56, 3), dtype=np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            label = 1 + (shard * 6 + i) % 1000
+            labels_written.append(label - 1)
+            recs.append(records.build_example({
+                "image/encoded": buf.getvalue(),
+                "image/class/label": [label],
+            }))
+        records.write_tfrecord_file(
+            str(tmp_path / f"validation-{shard:05d}-of-00128"), recs)
+    batches = list(imagenet.imagenet_input_fn(
+        str(tmp_path), False, 8, process_id=0, process_count=1,
+        drop_remainder=False, num_threads=2))
+    assert len(batches) == 2  # ceil(12/8)
+    masks = np.concatenate([b[2] for b in batches])
+    assert masks.sum() == 12
+    got = np.concatenate([b[1] for b in batches])[masks == 1]
+    np.testing.assert_array_equal(np.sort(got), np.sort(labels_written))
+
+
+# --- trainer-level weighted eval ---------------------------------------
+
+def _trainer(cfg_kw=None, n_devices=2, num_classes=5):
+    spec = DatasetSpec("cifar10", 8, 3, num_classes, num_train=64,
+                       num_eval=10, one_hot=True)
+    cfg = Config(model="trivial", dataset="cifar10", batch_size=4,
+                 train_steps=1, skip_eval=True, model_dir="",
+                 **(cfg_kw or {}))
+    mesh = make_mesh(jax.devices()[:n_devices], data=n_devices)
+    rt = MeshRuntime(mesh=mesh, strategy="mirrored")
+    model, l2 = build_model("trivial", num_classes=num_classes)
+    return Trainer(cfg, rt, model, l2, spec), model
+
+
+def test_weighted_eval_matches_manual_full_pass():
+    """Masked eval over padded batches == plain mean over the 10 real
+    examples — the bit the drop-remainder loop under-covered."""
+    trainer, model = _trainer()
+    rng = np.random.default_rng(3)
+    all_imgs = rng.normal(0, 1, (10, 8, 8, 3)).astype(np.float32)
+    all_lbls = rng.integers(0, 5, (10,)).astype(np.int32)
+    state = trainer.init_state(jax.random.key(0),
+                               (all_imgs[:4], all_lbls[:4]))
+
+    pad_imgs = np.zeros((4, 8, 8, 3), np.float32)
+    pad_imgs[:2] = all_imgs[8:]
+    pad_lbls = np.zeros((4,), np.int32)
+    pad_lbls[:2] = all_lbls[8:]
+    batches = [
+        (all_imgs[:4], all_lbls[:4]),  # legacy 2-tuple: mask of ones
+        (all_imgs[4:8], all_lbls[4:8],
+         np.ones((4,), np.float32)),
+        (pad_imgs, pad_lbls, np.array([1, 1, 0, 0], np.float32)),
+    ]
+    loss, top1 = trainer.evaluate(state, iter(batches))
+
+    import optax
+    logits = model.apply({"params": jax.device_get(state.params)},
+                         all_imgs, train=False)
+    want_loss = float(np.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, all_lbls)))
+    want_top1 = float(np.mean(np.argmax(logits, -1) == all_lbls))
+    assert loss == pytest.approx(want_loss, rel=1e-5)
+    assert top1 == pytest.approx(want_top1, abs=1e-6)
+
+
+def test_report_accuracy_metrics_false_drops_accuracy():
+    trainer, _ = _trainer({"report_accuracy_metrics": False})
+    rng = np.random.default_rng(4)
+    imgs = rng.normal(0, 1, (4, 8, 8, 3)).astype(np.float32)
+    lbls = rng.integers(0, 5, (4,)).astype(np.int32)
+    state = trainer.init_state(jax.random.key(0), (imgs, lbls))
+    batch = trainer.rt.shard_batch((imgs, lbls))
+    state, metrics = trainer.train_step(state, *batch)
+    assert "accuracy" not in metrics
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    out = trainer.evaluate(state, iter([(imgs, lbls)]))
+    assert out[1] is None and np.isfinite(out[0])
+    from dtf_tpu.utils.logs import build_stats
+    stats = build_stats({"loss": [1.0], "categorical_accuracy": []}, out,
+                        None)
+    assert "accuracy_top_1" not in stats
+    assert "training_accuracy_top_1" not in stats
+    assert "eval_loss" in stats
+
+
+def test_channels_first_exact_match():
+    """NCHW input + in-step transpose computes the identical step."""
+    rng = np.random.default_rng(5)
+    imgs = rng.normal(0, 1, (4, 8, 8, 3)).astype(np.float32)
+    lbls = rng.integers(0, 5, (4,)).astype(np.int32)
+
+    t_last, _ = _trainer()
+    s_last = t_last.init_state(jax.random.key(0), (imgs, lbls))
+    s_last, m_last = t_last.train_step(
+        s_last, *t_last.rt.shard_batch((imgs, lbls)))
+
+    t_first, _ = _trainer({"data_format": "channels_first"})
+    nchw = np.ascontiguousarray(imgs.transpose(0, 3, 1, 2))
+    s_first = t_first.init_state(jax.random.key(0), (nchw, lbls))
+    s_first, m_first = t_first.train_step(
+        s_first, *t_first.rt.shard_batch((nchw, lbls)))
+
+    assert float(jax.device_get(m_first["loss"])) == pytest.approx(
+        float(jax.device_get(m_last["loss"])), rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(s_first.params),
+                    jax.tree_util.tree_leaves(s_last.params)):
+        np.testing.assert_allclose(jax.device_get(a), jax.device_get(b),
+                                   rtol=1e-5)
+
+
+def test_config_rejects_unknown_data_format():
+    with pytest.raises(ValueError, match="data_format"):
+        Config(data_format="NCHW")
+
+
+def test_get_next_as_optional_forces_partial_batch_eval():
+    cfg = Config(enable_get_next_as_optional=True, drop_remainder=True)
+    assert cfg.drop_remainder is False
+
+
+def test_run_channels_first_end_to_end(monkeypatch):
+    """run() with channels_first: pipelines feed NCHW, same final loss."""
+    from dtf_tpu.cli import run
+    tiny = dataclasses.replace(data_base.CIFAR10, image_size=8,
+                               num_train=32, num_eval=8)
+    monkeypatch.setitem(data_base._SPECS, "cifar10", tiny)
+    common = dict(model="resnet20", dataset="cifar10",
+                  use_synthetic_data=True, train_steps=2, batch_size=8,
+                  skip_checkpoint=True, model_dir="", log_steps=1)
+    s_last = run(Config(**common))
+    s_first = run(Config(**common, data_format="channels_first"))
+    assert s_first["loss"] == pytest.approx(s_last["loss"], rel=1e-6)
+    assert s_first["accuracy_top_1"] == pytest.approx(
+        s_last["accuracy_top_1"], abs=1e-6)

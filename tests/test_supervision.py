@@ -38,9 +38,22 @@ def test_heartbeat_kills_hung_rank(tmp_path):
     rc = launch_local([sys.executable, "-c", script], num_processes=2,
                       coordinator="localhost:0",
                       log_dir=str(tmp_path / "logs"),
-                      devices_per_process=None, heartbeat_timeout=2.0)
+                      devices_per_process=None, heartbeat_timeout=2.0,
+                      startup_grace=2.0)
     assert rc != 0
     assert time.monotonic() - t0 < 60
+
+
+def test_startup_grace_spares_slow_starter(tmp_path):
+    """A rank silent longer than heartbeat_timeout but inside the
+    startup grace (XLA compile, checkpoint restore) is not killed."""
+    script = ("import time; time.sleep(3); print('compiled', flush=True)")
+    rc = launch_local([sys.executable, "-c", script], num_processes=1,
+                      coordinator="localhost:0",
+                      log_dir=str(tmp_path / "logs"),
+                      devices_per_process=None, heartbeat_timeout=1.0,
+                      startup_grace=30.0)
+    assert rc == 0
 
 
 def test_hosts_mode_rejects_supervision_flags():
