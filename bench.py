@@ -1,6 +1,12 @@
 """Benchmark: ResNet-50 ImageNet-shape training throughput per chip.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}
+plus roofline context fields:
+  - step_ms: mean wall time of one optimizer step
+  - mfu: model FLOP utilization — XLA's own flop count for the compiled
+    train step (fwd+bwd+update, 2·MAC convention) divided by step time
+    and the chip's peak bf16 FLOP/s.  Peak is looked up from the device
+    kind; unknown kinds report mfu=null rather than a made-up number.
 
 Baseline: the reference's best steady-state per-GPU rate — 168.6
 images/s on a Tesla P40 under the 16-process ParameterServer run
@@ -8,9 +14,21 @@ images/s on a Tesla P40 under the 16-process ParameterServer run
 runs the same workload shape (ResNet-50 v1.5, 224×224, synthetic data,
 full train step incl. gradient all-reduce) on however many chips are
 attached and reports images/sec/chip.
+
+Roofline notes (v5 lite, r2 measurements): r1's 1,937 img/s was lifted
+to ~2,430 by (a) bf16 BatchNorm I/O — r1 ran BN in fp32, doubling the
+HBM traffic of every conv→BN→relu link (+20%), and (b) the
+space-to-depth stem (exact 7×7/2/3ch → 4×4/1/12ch reformulation,
+models/resnet.py Conv1SpaceToDepth, +4%).  A fwd/bwd/update split at
+batch 256 gives 37.8 / 65.6 / ~2 ms: the step is conv-compute-bound at
+~30% MFU with XLA-scheduled convs (BN/relu links between convs are
+HBM-bound and XLA already fuses them); pushing past ~30% needs
+hand-fused conv+BN+relu Pallas kernels or a layout change, not loop or
+optimizer work.
 """
 
 import json
+import re
 import sys
 import time
 
@@ -19,6 +37,34 @@ import jax.numpy as jnp
 import numpy as np
 
 BASELINE_IMG_PER_SEC_PER_DEVICE = 168.6
+
+# Peak dense bf16 TFLOP/s by TPU generation (public spec sheets).
+# Keys are matched case-insensitively against jax device_kind.
+PEAK_BF16_TFLOPS = {
+    "v6e": 918.0, "v6": 918.0,
+    "v5p": 459.0,
+    "v5 lite": 197.0, "v5e": 197.0, "v5litepod": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
+
+
+def peak_tflops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def is_oom(e: Exception) -> bool:
+    """Only retry smaller batches on resource exhaustion — any other
+    failure must surface (the r1 bench swallowed real regressions)."""
+    msg = f"{type(e).__name__}: {e}"
+    return bool(re.search(r"RESOURCE_EXHAUSTED|out of memory|OOM|"
+                          r"Resource exhausted|memory space hbm", msg,
+                          re.IGNORECASE))
 
 
 def run_bench(per_chip_batch: int, warmup: int = 5, iters: int = 20):
@@ -43,6 +89,18 @@ def run_bench(per_chip_batch: int, warmup: int = 5, iters: int = 20):
     state = trainer.init_state(jax.random.key(0), (images, labels))
     batch = rt.shard_batch((images, labels))
 
+    # XLA's flop count for exactly this compiled step.  NB: for an
+    # SPMD-partitioned executable cost_analysis reports the PER-DEVICE
+    # module's flops, so it pairs with one chip's peak below (no
+    # n_chips factor on either side).
+    step_flops = None
+    try:
+        ca = trainer.train_step.lower(state, *batch).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        step_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
     # NB: sync via device_get of a non-donated output. On some remote
     # platforms block_until_ready returns before the computation
     # finishes; a host copy of the result cannot be faked.
@@ -55,20 +113,28 @@ def run_bench(per_chip_batch: int, warmup: int = 5, iters: int = 20):
         state, metrics = trainer.train_step(state, *batch)
     loss = float(jax.device_get(metrics["loss"]))
     elapsed = time.perf_counter() - t0
-    assert np.isfinite(loss), f"non-finite loss {loss}" 
+    assert np.isfinite(loss), f"non-finite loss {loss}"
 
     images_per_sec = global_batch * iters / elapsed
-    return images_per_sec / n_chips, n_chips
+    step_ms = elapsed / iters * 1e3
+    mfu = None
+    peak = peak_tflops(jax.devices()[0])
+    if step_flops and peak:
+        mfu = (step_flops / (elapsed / iters)) / (peak * 1e12)
+    return images_per_sec / n_chips, n_chips, step_ms, mfu
 
 
 def main():
-    # 384 measured fastest per-chip on v5e (1978 img/s vs 1962 @256,
-    # 1926 @512); fall back on OOM for smaller-HBM chips
-    for batch in (384, 256, 128, 64):
+    # 256 measured fastest per-chip on v5 lite (2,432 img/s vs 2,431
+    # @384, 2,306 @512, 2,386 @128); fall back on OOM
+    err = None
+    for batch in (256, 384, 128, 64):
         try:
-            per_chip, n_chips = run_bench(batch)
+            per_chip, n_chips, step_ms, mfu = run_bench(batch)
             break
-        except Exception as e:  # OOM → retry smaller
+        except Exception as e:
+            if not is_oom(e):
+                raise
             err = e
             continue
     else:
@@ -81,6 +147,11 @@ def main():
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 2),
+        "step_ms": round(step_ms, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "per_chip_batch": batch,
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
     }))
 
 

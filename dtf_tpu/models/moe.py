@@ -7,22 +7,31 @@ parallelism matrix TPU-first.
 Design (GShard/Switch lineage, re-expressed for XLA):
   - **Static-shape dispatch.** Routing never gathers with dynamic
     shapes: a top-k router (k=1 Switch, k=2 GShard — ``router_top_k``)
-    builds dense one-hot dispatch/combine tensors ``[tokens, experts,
-    capacity]`` (capacity is a Python int at trace time, scaling with
-    k), and tokens move to experts as two einsums — pure MXU work that
-    XLA tiles freely.  Tokens beyond an expert's capacity are dropped
-    (their MoE output is 0; the residual carries them), exactly the
-    GShard overflow rule.  Top-2 gates renormalize to sum to 1
-    (GShard); top-1 keeps the raw router probability (Switch — the
-    router's gradient path).
-  - **Expert parallelism rides the 'data' axis.** Experts shard over
-    the same mesh axis the batch is sharded over (the classic
-    DeepSpeed-MoE/GShard placement): each data shard holds
-    ``E / ep`` experts, and two tiled ``lax.all_to_all`` collectives
+    assigns every token an (expert, capacity-slot) pair and moves
+    tokens with a static scatter-add into the ``[experts·capacity, d]``
+    slot buffer and a gather back (``dispatch_mode="scatter"``, the
+    default) — O(n·k·d + E·C·d) memory.  The r1 dense formulation
+    (one-hot ``[tokens, experts, capacity]`` einsums, O(n·E·C)) is kept
+    as ``dispatch_mode="dense"``: it is the numerical oracle in tests
+    and the faster choice for tiny E.  Tokens beyond an expert's
+    capacity are dropped (their MoE output is 0; the residual carries
+    them), exactly the GShard overflow rule.  Top-2 gates renormalize
+    to sum to 1 (GShard); top-1 keeps the raw router probability
+    (Switch — the router's gradient path).
+  - **Expert parallelism, two placements.**
+    (a) Over the batch ('data') axis — the classic DeepSpeed-MoE/GShard
+    placement (``expert_axis_along_batch=True``): each data shard holds
+    ``E / ep`` experts and two tiled ``lax.all_to_all`` collectives
     (ICI) exchange capacity slots so every expert sees the tokens
-    routed to it from the whole expert group.  No parameter or
-    optimizer-state duplication for experts — per-device HBM holds
-    only the local experts.
+    routed to it from the whole group.
+    (b) Over the 'model' axis (``--model_parallelism`` with a MoE
+    family; ``expert_axis_along_batch=False``): the batch is replicated
+    across 'model', so no token exchange is needed at all — each model
+    rank runs its E/mp experts on the tokens routed to them and the
+    partial outputs psum over 'model' (`tp_psum`: identity backward).
+    This decouples the expert-parallel group size from the DP world —
+    E=8 experts on dp=64 runs as mesh (64, 1, 8) — at the cost of
+    replicating the dense blocks' compute across 'model'.
   - **Router in fp32** (softmax numerics), expert matmuls in the
     compute dtype (bf16 on TPU), combine in fp32.
   - **Aux load-balance loss** (Switch §2.2 form: ``E · Σ f_e · p_e``)
@@ -30,10 +39,12 @@ Design (GShard/Switch lineage, re-expressed for XLA):
     sown aux term to the objective.
 
 Gradient contract (enforced by ``Trainer`` via
-``moe_param_partition_specs``): expert leaves are sharded over 'data',
-so their local grads — which reverse-mode all_to_all already sums
-across the expert group — are divided by the data-axis size instead of
-being pmean-ed (a pmean would average *different experts'* grads).
+``moe_param_partition_specs``): placement (a)'s expert leaves are
+sharded over 'data', so their local grads — which reverse-mode
+all_to_all already sums across the expert group — are divided by the
+data-axis size instead of being pmean-ed (a pmean would average
+*different experts'* grads).  Placement (b)'s leaves shard over
+'model' and take the ordinary data-parallel pmean.
 """
 
 from __future__ import annotations
@@ -64,6 +75,12 @@ class MoEMLP(nn.Module):
     router_top_k: int = 2    # 1 = Switch routing, 2 = GShard top-2
     dtype: Any = jnp.float32
     expert_axis: Optional[str] = None
+    # True: expert_axis also shards the batch (all_to_all exchange);
+    # False: batch replicated over expert_axis (local slice + psum)
+    expert_axis_along_batch: bool = True
+    # "scatter" (default): O(n·k·d + E·C·d) slot scatter/gather;
+    # "dense": r1's one-hot einsums, O(n·E·C) — oracle / tiny-E path
+    dispatch_mode: str = "scatter"
     aux_weight: float = 0.01
 
     @nn.compact
@@ -82,6 +99,15 @@ class MoEMLP(nn.Module):
                     f"num_experts {e} not divisible by expert-parallel "
                     f"group size {ep}")
             e_loc = e // ep
+        along_batch = self.expert_axis_along_batch
+        if self.dispatch_mode not in ("scatter", "dense"):
+            raise ValueError(f"unknown dispatch_mode {self.dispatch_mode!r}")
+        if (self.dispatch_mode == "dense" and self.expert_axis is not None
+                and not along_batch):
+            raise ValueError(
+                "dense dispatch implements the along-batch (all_to_all) "
+                "placement only; use dispatch_mode='scatter' for "
+                "model-axis expert parallelism")
 
         k_init = nn.initializers.lecun_normal(batch_axis=(0,))
         w1 = self.param("w1", k_init, (e_loc, d, self.d_ff))
@@ -100,12 +126,13 @@ class MoEMLP(nn.Module):
         k = min(k, e)  # a single expert degenerates top-2 to top-1
         # iterative top-k: each choice takes the argmax of what earlier
         # choices left (k=1 is Switch routing, k=2 is GShard's top-2)
-        masks = []
+        masks, idxs = [], []
         remaining = probs
         for _ in range(k):
             idx_c = jnp.argmax(remaining, axis=-1)
             m_c = jax.nn.one_hot(idx_c, e, dtype=jnp.float32)  # [n, E]
             masks.append(m_c)
+            idxs.append(idx_c.astype(jnp.int32))
             remaining = remaining * (1.0 - m_c)
 
         # load balance: fraction routed (first choice) × mean prob
@@ -116,9 +143,7 @@ class MoEMLP(nn.Module):
 
         # ---- capacity positions (static C) --------------------------
         cap = max(1, min(n, int(round(self.capacity_factor * k * n / e))))
-        dispatch = jnp.zeros((n, e, cap), jnp.float32)
-        combine = jnp.zeros((n, e, cap), jnp.float32)
-        gates, keeps, slots = [], [], []
+        keeps, slots = [], []
         count_prev = jnp.zeros((1, e), jnp.float32)
         for m_c in masks:
             # a choice's slots start after every earlier choice's tokens
@@ -126,10 +151,28 @@ class MoEMLP(nn.Module):
                 (jnp.cumsum(m_c, axis=0) - m_c + count_prev) * m_c,
                 axis=-1)                                    # [n]
             count_prev = count_prev + jnp.sum(m_c, axis=0, keepdims=True)
-            keep_c = (pos_c < cap).astype(jnp.float32)
-            gates.append(jnp.sum(probs * m_c, axis=-1) * keep_c)
-            keeps.append(keep_c)
-            slots.append(pos_c)
+            keeps.append((pos_c < cap).astype(jnp.float32))
+            slots.append(lax.stop_gradient(pos_c).astype(jnp.int32))
+
+        # Gate/token sources.  The model-axis placement consumes gates
+        # and tokens PER-RANK (each rank sees only its experts'
+        # contribution paths), so replicated producers — the router and
+        # everything upstream — must be entered through tp_region
+        # (identity forward, psum backward): the summed cotangent is
+        # exactly the unsharded gradient, and every rank derives
+        # identical replicated-param grads.  Without it the router
+        # kernel would silently desynchronize across 'model'.
+        model_axis_ep = (self.expert_axis is not None and not along_batch)
+        if model_axis_ep:
+            from dtf_tpu.parallel.collectives import tp_psum, tp_region
+            probs_src = tp_region(probs, self.expert_axis)
+            tok_src = tp_region(tokens.astype(jnp.float32),
+                                self.expert_axis)
+        else:
+            probs_src = probs
+            tok_src = tokens.astype(jnp.float32)
+        gates = [jnp.sum(probs_src * m_c, axis=-1) * keep_c
+                 for m_c, keep_c in zip(masks, keeps)]
         if k > 1:
             # GShard renormalizes the kept top-k gates to sum to 1
             denom = sum(gates)
@@ -139,35 +182,95 @@ class MoEMLP(nn.Module):
             # would make the gate a constant 1 and starve the router of
             # gradient signal
             denom = 1.0
-        for m_c, g_c, keep_c, pos_c in zip(masks, gates, keeps, slots):
-            # one_hot of an out-of-range position is all-zero, so
-            # dropped tokens vanish from dispatch/combine automatically
-            oh_c = jax.nn.one_hot(pos_c.astype(jnp.int32), cap,
-                                  dtype=jnp.float32) * keep_c[:, None]
-            slot = m_c[:, :, None] * oh_c[:, None, :]       # [n, E, C]
-            dispatch = dispatch + slot
-            combine = combine + (g_c / denom)[:, None, None] * slot
-        dispatch = lax.stop_gradient(dispatch)
 
-        # ---- dispatch → experts → combine ---------------------------
-        xin = jnp.einsum("nec,nd->ecd", dispatch,
-                         tokens.astype(jnp.float32)).astype(self.dtype)
-        if self.expert_axis is not None and ep > 1:
-            # NETWORK BOUNDARY: exchange capacity slots across the
-            # expert group so each device holds its local experts'
-            # tokens from every peer — [E, C, d] → [E/ep, ep·C, d]
-            xin = lax.all_to_all(xin, self.expert_axis, split_axis=0,
-                                 concat_axis=1, tiled=True)
-        h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(self.dtype))
-        h = nn.gelu(h + b1[:, None, :].astype(self.dtype))
-        out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
-        out = out + b2[:, None, :].astype(self.dtype)
-        if self.expert_axis is not None and ep > 1:
-            # inverse exchange: [E/ep, ep·C, d] → [E, C, d]
-            out = lax.all_to_all(out, self.expert_axis, split_axis=1,
-                                 concat_axis=0, tiled=True)
-        y = jnp.einsum("nec,ecd->nd", combine,
-                       out.astype(jnp.float32))
+        def run_experts(xin):
+            """[e_loc, slots, d] expert batch → same shape."""
+            h = jnp.einsum("ecd,edf->ecf", xin, w1.astype(self.dtype))
+            h = nn.gelu(h + b1[:, None, :].astype(self.dtype))
+            out = jnp.einsum("ecf,efd->ecd", h, w2.astype(self.dtype))
+            return out + b2[:, None, :].astype(self.dtype)
+
+        if self.dispatch_mode == "dense":
+            dispatch = jnp.zeros((n, e, cap), jnp.float32)
+            combine = jnp.zeros((n, e, cap), jnp.float32)
+            for m_c, g_c, keep_c, pos_c in zip(masks, gates, keeps, slots):
+                # one_hot of an out-of-range position is all-zero, so
+                # dropped tokens vanish from dispatch/combine
+                oh_c = jax.nn.one_hot(pos_c, cap,
+                                      dtype=jnp.float32) * keep_c[:, None]
+                slot = m_c[:, :, None] * oh_c[:, None, :]   # [n, E, C]
+                dispatch = dispatch + slot
+                combine = combine + (g_c / denom)[:, None, None] * slot
+            dispatch = lax.stop_gradient(dispatch)
+            xin = jnp.einsum("nec,nd->ecd", dispatch,
+                             tokens.astype(jnp.float32)).astype(self.dtype)
+            if self.expert_axis is not None and ep > 1:
+                # NETWORK BOUNDARY: exchange capacity slots across the
+                # expert group so each device holds its local experts'
+                # tokens from every peer — [E, C, d] → [E/ep, ep·C, d]
+                xin = lax.all_to_all(xin, self.expert_axis, split_axis=0,
+                                     concat_axis=1, tiled=True)
+            out = run_experts(xin)
+            if self.expert_axis is not None and ep > 1:
+                # inverse exchange: [E/ep, ep·C, d] → [E, C, d]
+                out = lax.all_to_all(out, self.expert_axis, split_axis=1,
+                                     concat_axis=0, tiled=True)
+            y = jnp.einsum("nec,ecd->nd", combine,
+                           out.astype(jnp.float32))
+            return y.reshape(b, s, d).astype(x.dtype)
+
+        # ---- scatter dispatch (default): no [n, E, C] tensor --------
+        tok32 = tok_src
+        if along_batch or self.expert_axis is None:
+            rows = e * cap
+            xin_flat = jnp.zeros((rows, d), jnp.float32)
+            for idx_c, pos_c, keep_c in zip(idxs, slots, keeps):
+                # out-of-capacity tokens get index `rows` → mode="drop"
+                safe = jnp.where(keep_c > 0, idx_c * cap + pos_c, rows)
+                xin_flat = xin_flat.at[safe].add(
+                    tok32 * keep_c[:, None], mode="drop")
+            xin = xin_flat.reshape(e, cap, d).astype(self.dtype)
+            if self.expert_axis is not None and ep > 1:
+                # NETWORK BOUNDARY (see dense path)
+                xin = lax.all_to_all(xin, self.expert_axis, split_axis=0,
+                                     concat_axis=1, tiled=True)
+            out = run_experts(xin)
+            if self.expert_axis is not None and ep > 1:
+                out = lax.all_to_all(out, self.expert_axis, split_axis=1,
+                                     concat_axis=0, tiled=True)
+            out_flat = out.reshape(rows, d).astype(jnp.float32)
+            y = jnp.zeros((n, d), jnp.float32)
+            for idx_c, pos_c, keep_c, g_c in zip(idxs, slots, keeps, gates):
+                safe = jnp.where(keep_c > 0, idx_c * cap + pos_c, 0)
+                y = y + (g_c / denom)[:, None] * out_flat[safe]
+            return y.reshape(b, s, d).astype(x.dtype)
+
+        # experts over a non-batch axis ('model'): the batch is
+        # replicated across the axis, so each rank scatters only the
+        # tokens routed to ITS E/mp experts and partial outputs psum —
+        # no all_to_all, no token movement at all
+        rank = lax.axis_index(self.expert_axis)
+        rows = e_loc * cap
+        xin_flat = jnp.zeros((rows, d), jnp.float32)
+        oks = []
+        for idx_c, pos_c, keep_c in zip(idxs, slots, keeps):
+            local = idx_c - rank * e_loc
+            ok = ((local >= 0) & (local < e_loc)
+                  & (keep_c > 0)).astype(jnp.float32)
+            oks.append(ok)
+            safe = jnp.where(ok > 0, local * cap + pos_c, rows)
+            xin_flat = xin_flat.at[safe].add(tok32 * ok[:, None],
+                                             mode="drop")
+        out = run_experts(xin_flat.reshape(e_loc, cap, d).astype(self.dtype))
+        out_flat = out.reshape(rows, d).astype(jnp.float32)
+        y = jnp.zeros((n, d), jnp.float32)
+        for idx_c, pos_c, ok, g_c in zip(idxs, slots, oks, gates):
+            local = idx_c - rank * e_loc
+            safe = jnp.where(ok > 0, local * cap + pos_c, 0)
+            y = y + (ok * g_c / denom)[:, None] * out_flat[safe]
+        # identity backward: every rank's partial already carries the
+        # full cotangent of its own tokens' outputs
+        y = tp_psum(y, self.expert_axis)
         return y.reshape(b, s, d).astype(x.dtype)
 
 
@@ -182,6 +285,8 @@ class MoEBlock(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     expert_axis: Optional[str] = None
+    expert_axis_along_batch: bool = True
+    dispatch_mode: str = "scatter"
     aux_weight: float = 0.01
     use_pallas: Any = None
 
@@ -196,7 +301,9 @@ class MoEBlock(nn.Module):
             self.num_experts, self.d_ff,
             capacity_factor=self.capacity_factor,
             router_top_k=self.router_top_k, dtype=self.dtype,
-            expert_axis=self.expert_axis, aux_weight=self.aux_weight,
+            expert_axis=self.expert_axis,
+            expert_axis_along_batch=self.expert_axis_along_batch,
+            dispatch_mode=self.dispatch_mode, aux_weight=self.aux_weight,
             name="moe")(h)
 
 
@@ -205,9 +312,10 @@ class MoETransformerLM(nn.Module):
     block (the interleaved dense/MoE stacking of GShard/ST-MoE).
 
     Composes with sequence parallelism (``seq_axis``: ring attention;
-    routing is per-token and needs no cross-shard coordination) — but
-    not with Megatron tensor parallelism (experts already shard the ff
-    computation)."""
+    routing is per-token and needs no cross-shard coordination).  The
+    'model' axis is available as a dedicated expert axis
+    (``expert_axis_along_batch=False``) rather than for Megatron TP of
+    the dense layers — experts already shard the ff computation."""
 
     vocab_size: int
     num_layers: int = 12
@@ -223,6 +331,8 @@ class MoETransformerLM(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None
     expert_axis: Optional[str] = None
+    expert_axis_along_batch: bool = True
+    dispatch_mode: str = "scatter"
     use_pallas: Any = None
     remat: bool = False
 
@@ -252,6 +362,8 @@ class MoETransformerLM(nn.Module):
                     capacity_factor=self.capacity_factor,
                     router_top_k=self.router_top_k, dtype=self.dtype,
                     seq_axis=self.seq_axis, expert_axis=self.expert_axis,
+                    expert_axis_along_batch=self.expert_axis_along_batch,
+                    dispatch_mode=self.dispatch_mode,
                     aux_weight=self.aux_weight, use_pallas=self.use_pallas,
                     name=f"block{i}")(x)
             else:

@@ -26,6 +26,17 @@ Trainer special-casing:
     broadcast (``last_stage_broadcast``) before the head, so every
     stage computes the head on identical inputs and gets identical
     grads directly.
+
+Why every stage recomputes the head (vs last-stage-only + logits
+broadcast): broadcasting [b,s,V] logits costs 4·b·s·V bytes of ICI
+while recomputing costs 2·b·s·d·V MXU flops — per logit element that is
+4 bytes of ICI (~10s of GB/s per link) vs 2·d flops (~100s of TFLOP/s);
+for any d ≥ a few hundred the recompute is faster and removes a
+serialization point.  The [b,s,d] broadcast before the head is the
+cheap one.  The GPipe bubble is attacked where the SPMD formulation
+allows: the runner auto-scales num_microbatches to 4·pp (bubble
+(pp-1)/(M+pp-1) ≤ ~20%); per-tick idle-stage compute skipping would
+need per-device control flow that SPMD scan cannot express.
 """
 
 from __future__ import annotations

@@ -24,12 +24,44 @@ from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 BATCH_NORM_DECAY = 0.9
 BATCH_NORM_EPSILON = 1e-5
 
 conv_init = nn.initializers.he_normal()
 dense_init = nn.initializers.normal(stddev=0.01)
+
+
+class Conv1SpaceToDepth(nn.Module):
+    """The stem 7×7/2 conv, computed as a 4×4/1 conv over 2×2
+    space-to-depth blocks — numerically identical, ~4× better MXU
+    utilization (12 input channels instead of 3; the standard TPU
+    ResNet stem trick).  The parameter keeps the reference shape
+    (7,7,3,64) and the `conv1/kernel` tree path, so checkpoints and
+    the plain-conv path are interchangeable; the zero-pad + block
+    reshape of the kernel is traced into the step (trivially small)."""
+    features: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", conv_init, (7, 7, 3, 64),
+                            jnp.float32)
+        b, h, w, c = x.shape
+        x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+        # 2×2 space-to-depth: [B, (H+6)/2, (W+6)/2, 12]
+        hb, wb = (h + 6) // 2, (w + 6) // 2
+        x = x.reshape(b, hb, 2, wb, 2, c).transpose(0, 1, 3, 2, 4, 5)
+        x = x.reshape(b, hb, wb, 4 * c).astype(self.dtype)
+        # kernel 7×7 → zero-pad to 8×8 → 4×4 blocks over 12 channels
+        k = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        k = k.reshape(4, 2, 4, 2, c, self.features)
+        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                  self.features)
+        return lax.conv_general_dilated(
+            x, k.astype(self.dtype), window_strides=(1, 1),
+            padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BottleneckBlock(nn.Module):
@@ -45,10 +77,13 @@ class BottleneckBlock(nn.Module):
         f1, f2, f3 = self.filters
         conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
                        dtype=self.dtype, param_dtype=jnp.float32)
+        # dtype=self.dtype keeps activations bf16 between convs (half the
+        # HBM traffic of fp32 BN I/O — the r1 bench's top time sink); the
+        # mean/var math itself is still fp32 (flax _compute_stats upcasts)
         bn = partial(nn.BatchNorm, use_running_average=not train,
                      axis_name=self.bn_axis,
                      momentum=BATCH_NORM_DECAY, epsilon=BATCH_NORM_EPSILON,
-                     dtype=jnp.float32, param_dtype=jnp.float32)
+                     dtype=self.dtype, param_dtype=jnp.float32)
         shortcut = x
         y = conv(f1, (1, 1), name="conv_a")(x)
         y = bn(name="bn_a")(y)
@@ -71,18 +106,27 @@ class ResNet50(nn.Module):
     num_classes: int = 1001
     dtype: Any = jnp.float32
     bn_axis: Any = None  # axis_name for cross-replica (sync) BN
+    # stem as a space-to-depth conv (exact reformulation, see
+    # Conv1SpaceToDepth); False = the literal reference conv1
+    stem_space_to_depth: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = x.astype(self.dtype)
         # conv1: explicit (3,3) pad + VALID 7×7/2 ≡ reference conv1_pad+conv1
-        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, kernel_init=conv_init, dtype=self.dtype,
-                    param_dtype=jnp.float32, name="conv1")(x)
+        if self.stem_space_to_depth and x.shape[1] % 2 == 0 and \
+                x.shape[2] % 2 == 0 and x.shape[3] == 3:
+            x = Conv1SpaceToDepth(dtype=self.dtype, name="conv1")(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)],
+                        use_bias=False, kernel_init=conv_init,
+                        dtype=self.dtype,
+                        param_dtype=jnp.float32, name="conv1")(x)
         x = nn.BatchNorm(use_running_average=not train,
                          axis_name=self.bn_axis,
                          momentum=BATCH_NORM_DECAY, epsilon=BATCH_NORM_EPSILON,
-                         dtype=jnp.float32, param_dtype=jnp.float32,
+                         dtype=self.dtype, param_dtype=jnp.float32,
                          name="bn_conv1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -16,6 +16,12 @@ choosing M ≥ pp.  Bubble ticks still execute the stage computation on
 placeholder data (XLA needs static control flow — SURVEY's "no
 data-dependent Python control flow under jit" rule); their results are
 masked out of the output buffer and receive zero cotangents.
+
+The runner auto-scales M to 4·pp when --num_microbatches is unset
+(halving to divide the per-shard batch).  Measured effect at pp=4 on
+the 8-device CPU mesh, same global batch: M=4 → 3106 ms/step,
+M=16 → 1916 ms/step (1.62×) — the bubble+placeholder-compute fraction
+goes from (7-4)/7 = 43% of ticks to (19-16)/19 = 16%.
 """
 
 from __future__ import annotations

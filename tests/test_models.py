@@ -31,6 +31,30 @@ def test_resnet50_param_count():
     assert n_params(v["params"]) == 25_559_081
 
 
+def test_resnet50_space_to_depth_stem_exact():
+    """The s2d stem (Conv1SpaceToDepth) is a pure reformulation of the
+    reference 7×7/2 conv: same param tree, same logits."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 32, 32, 3)).astype(np.float32))
+    m_s2d = ResNet50(num_classes=11)
+    m_ref = ResNet50(num_classes=11, stem_space_to_depth=False)
+    v = m_s2d.init(jax.random.key(0), x, train=False)
+    assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(
+        m_ref.init(jax.random.key(0), x, train=False))
+    np.testing.assert_allclose(
+        np.asarray(m_s2d.apply(v, x, train=False)),
+        np.asarray(m_ref.apply(v, x, train=False)), atol=5e-4)
+
+
+def test_resnet50_odd_input_falls_back_to_plain_conv():
+    """Non-even spatial dims can't space-to-depth; the plain conv path
+    keeps the model usable on any input size."""
+    x = jnp.zeros((1, 33, 33, 3), jnp.float32)
+    m = ResNet50(num_classes=5)
+    v = m.init(jax.random.key(0), x, train=False)
+    assert m.apply(v, x, train=False).shape == (1, 5)
+
+
 def test_resnet56_param_count():
     m = resnet56()
     v = jax.eval_shape(

@@ -253,3 +253,56 @@ def test_ep_with_seq_parallel(tiny_moe_registry):
 def test_moe_eval(tiny_moe_registry):
     stats = run(base_cfg(num_devices=2, skip_eval=False))
     assert np.isfinite(stats["eval_loss"])
+
+
+def test_scatter_dispatch_matches_dense_oracle():
+    """The r2 O(n·k·d + E·C·d) scatter dispatch is a reformulation of
+    the r1 dense one-hot einsums — same outputs, same gradients, with a
+    real capacity limit so the overflow-drop path is exercised too."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)), jnp.float32)
+    dense = MoEMLP(num_experts=4, d_ff=16, capacity_factor=0.5,
+                   dispatch_mode="dense")
+    scat = MoEMLP(num_experts=4, d_ff=16, capacity_factor=0.5,
+                  dispatch_mode="scatter")
+    params = dense.init(jax.random.key(0), x)["params"]
+
+    def loss(m, p):
+        return jnp.sum(jnp.square(m.apply({"params": p}, x)))
+
+    yd = dense.apply({"params": params}, x)
+    ys = scat.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(yd), np.asarray(ys),
+                               atol=1e-5, rtol=1e-5)
+    gd = jax.grad(lambda p: loss(dense, p))(params)
+    gs = jax.grad(lambda p: loss(scat, p))(params)
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(gd),
+            jax.tree_util.tree_leaves_with_path(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+def test_ep_over_model_axis_matches_single_device(tiny_moe_registry):
+    """Experts on the 'model' axis (r1 hard-errored here): group size
+    decoupled from dp — dp=2 × ep=4 — same trajectory as one device."""
+    s1 = run(base_cfg(distribution_strategy="off"))
+    s2 = run(base_cfg(model_parallelism=4, num_devices=8))
+    np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=2e-3)
+
+
+def test_ep_over_model_axis_with_drops_trains(tiny_moe_registry):
+    """Model-axis EP with a real capacity limit (drops differ per rank)
+    still trains and stays replica-consistent."""
+    stats = run(base_cfg(model_parallelism=2, num_devices=4,
+                         moe_capacity_factor=1.0, skip_eval=False))
+    assert np.isfinite(stats["loss"])
+    assert np.isfinite(stats["eval_loss"])
+
+
+def test_e16_on_dp4_trains(tiny_moe_registry):
+    """VERDICT r1 #8 'done when': E=16 experts on dp=4 trains with the
+    scatter dispatch (no [n, E, C] tensor)."""
+    stats = run(base_cfg(num_experts=16, num_devices=4))
+    assert np.isfinite(stats["loss"])

@@ -206,3 +206,23 @@ def test_pp_with_data_parallel(tiny_pipe_registry):
 def test_pp_eval(tiny_pipe_registry):
     stats = run(base_cfg(model_parallelism=2, skip_eval=False))
     assert np.isfinite(stats["eval_loss"])
+
+
+def test_pp_auto_microbatches(tiny_pipe_registry):
+    """--num_microbatches unset: the runner targets 4·pp (≤20% bubble),
+    halving to fit the per-shard batch — here pp=2, per-shard batch 8
+    → M=8 (dp=4, per-shard batch 8) — and the run still trains."""
+    from unittest import mock
+    from dtf_tpu.models.pipeline_lm import PipelinedTransformerLM as PLM
+    captured = {}
+    orig = PLM.__init__
+
+    def spy(self, *a, **kw):
+        captured.update(kw)
+        return orig(self, *a, **kw)
+
+    with mock.patch.object(PLM, "__init__", spy):
+        s2 = run(base_cfg(model_parallelism=2, num_microbatches=None,
+                          batch_size=32))
+    assert captured.get("num_microbatches") == 8
+    assert np.isfinite(s2["loss"])
