@@ -253,6 +253,19 @@ static void bilinear_resize_sub(const uint8_t* src, int sh, int sw,
                                 const float* sub) {
   const float sy = static_cast<float>(sh) / oh;
   const float sx = static_cast<float>(sw) / ow;
+  // column sampling tables, computed once (not per row)
+  std::vector<int> xas(ow), xbs(ow);
+  std::vector<float> wxs(ow);
+  for (int c = 0; c < ow; c++) {
+    // flip(resize(x)) == resize(flip(x)) for symmetric half-pixel
+    // sampling, so the flip fuses into the source column lookup
+    int cc = flip ? (ow - 1 - c) : c;
+    float fx = (cc + 0.5f) * sx - 0.5f;
+    int x0 = static_cast<int>(floorf(fx));
+    wxs[c] = fx - x0;
+    xas[c] = 3 * (x0 < 0 ? 0 : (x0 >= sw ? sw - 1 : x0));
+    xbs[c] = 3 * (x0 + 1 < 0 ? 0 : (x0 + 1 >= sw ? sw - 1 : x0 + 1));
+  }
   for (int r = 0; r < oh; r++) {
     float fy = (r + 0.5f) * sy - 0.5f;
     int y0 = static_cast<int>(floorf(fy));
@@ -263,17 +276,11 @@ static void bilinear_resize_sub(const uint8_t* src, int sh, int sw,
     const uint8_t* rowb = src + static_cast<size_t>(yb) * sw * 3;
     float* out_row = dst + static_cast<size_t>(r) * ow * 3;
     for (int c = 0; c < ow; c++) {
-      // flip(resize(x)) == resize(flip(x)) for symmetric half-pixel
-      // sampling, so the flip fuses into the source column lookup
-      int cc = flip ? (ow - 1 - c) : c;
-      float fx = (cc + 0.5f) * sx - 0.5f;
-      int x0 = static_cast<int>(floorf(fx));
-      float wx = fx - x0;
-      int xa = x0 < 0 ? 0 : (x0 >= sw ? sw - 1 : x0);
-      int xb = x0 + 1 < 0 ? 0 : (x0 + 1 >= sw ? sw - 1 : x0 + 1);
+      const int xa = xas[c], xb = xbs[c];
+      const float wx = wxs[c];
       for (int ch = 0; ch < 3; ch++) {
-        float top = (1.0f - wx) * rowa[xa * 3 + ch] + wx * rowa[xb * 3 + ch];
-        float bot = (1.0f - wx) * rowb[xa * 3 + ch] + wx * rowb[xb * 3 + ch];
+        float top = (1.0f - wx) * rowa[xa + ch] + wx * rowa[xb + ch];
+        float bot = (1.0f - wx) * rowb[xa + ch] + wx * rowb[xb + ch];
         out_row[c * 3 + ch] =
             (1.0f - wy) * top + wy * bot - sub[ch];
       }

@@ -67,6 +67,107 @@ def test_two_process_training(tmp_path):
     assert all("data=4" in t for t in logs)
 
 
+EVAL_WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dtf_tpu.config import Config
+from dtf_tpu.config.flags import apply_env_topology
+from dtf_tpu.data.base import DatasetSpec
+from dtf_tpu.data.cifar import cifar_input_fn
+from dtf_tpu.models import build_model
+from dtf_tpu.runtime import initialize
+from dtf_tpu.train import Trainer
+
+data_dir = os.environ["DTF_TEST_DATA_DIR"]
+spec = DatasetSpec("cifar10", 32, 3, 10, num_train=100, num_eval=30,
+                   one_hot=True)
+cfg = apply_env_topology(Config(
+    model="trivial", dataset="cifar10", batch_size=8, train_steps=1,
+    model_dir="", distribution_strategy="multi_worker_mirrored"))
+rt = initialize(cfg)
+model, l2 = build_model("trivial", num_classes=10)
+trainer = Trainer(cfg, rt, model, l2, spec)
+rng = np.random.default_rng(0)
+sample = (rng.normal(0, 1, (8, 32, 32, 3)).astype(np.float32),
+          rng.integers(0, 10, (8,)).astype(np.int32))
+state = trainer.init_state(jax.random.key(0), sample)
+host_batch = cfg.batch_size // jax.process_count()
+out = trainer.evaluate(state, cifar_input_fn(
+    data_dir, False, host_batch, drop_remainder=False))
+print("EVAL=%.8f,%.8f" % out)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_sharded_eval_matches_single_host(tmp_path):
+    """VERDICT r1 #4 'done when': padded+masked eval sharded over two
+    real processes reproduces a single-host full pass over the same
+    fixture — every example counted exactly once on exactly one host."""
+    import numpy as np
+    from dtf_tpu.data import cifar as cifar_mod
+
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    for name, n in [("data_batch_%d.bin" % i, 20) for i in range(1, 6)] + \
+                   [("test_batch.bin", 30)]:
+        recs = np.zeros((n, cifar_mod.RECORD_BYTES), np.uint8)
+        recs[:, 0] = rng.integers(0, 10, n)
+        recs[:, 1:] = rng.integers(0, 256, (n, 3072))
+        (d / name).write_bytes(recs.tobytes())
+
+    script = tmp_path / "eval_worker.py"
+    script.write_text(EVAL_WORKER)
+    env = dict(os.environ, PYTHONPATH=REPO,
+               DTF_TEST_DATA_DIR=str(tmp_path))
+    rc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.cli.launch",
+         "--num_processes", "2", "--coordinator", "localhost:12431",
+         "--log_dir", str(tmp_path / "logs"), "--",
+         sys.executable, str(script)],
+        cwd=REPO, timeout=600, capture_output=True, text=True, env=env)
+    logs = [(tmp_path / "logs" / f"log{i}.log").read_text()
+            for i in range(2)]
+    assert rc.returncode == 0, f"launcher failed:\n{logs[0][-1500:]}"
+    multi = []
+    for text in logs:
+        m = re.search(r"EVAL=([\d.]+),([\d.]+)", text)
+        assert m, f"no eval line:\n{text[-1500:]}"
+        multi.append((float(m.group(1)), float(m.group(2))))
+    assert multi[0] == multi[1]  # replicated collective result
+
+    # single-host full pass over the identical fixture + identical init
+    import jax
+    from dtf_tpu.config import Config
+    from dtf_tpu.data.base import DatasetSpec
+    from dtf_tpu.data.cifar import cifar_input_fn
+    from dtf_tpu.models import build_model
+    from dtf_tpu.train import Trainer
+    from dtf_tpu.runtime.mesh import MeshRuntime, make_mesh
+
+    spec = DatasetSpec("cifar10", 32, 3, 10, num_train=100, num_eval=30,
+                       one_hot=True)
+    cfg = Config(model="trivial", dataset="cifar10", batch_size=8,
+                 train_steps=1, model_dir="")
+    rt = MeshRuntime(mesh=make_mesh(jax.devices()[:4], data=4),
+                     strategy="mirrored")
+    model, l2 = build_model("trivial", num_classes=10)
+    trainer = Trainer(cfg, rt, model, l2, spec)
+    rng = np.random.default_rng(0)
+    sample = (rng.normal(0, 1, (8, 32, 32, 3)).astype(np.float32),
+              rng.integers(0, 10, (8,)).astype(np.int32))
+    state = trainer.init_state(jax.random.key(0), sample)
+    ref = trainer.evaluate(state, cifar_input_fn(
+        str(tmp_path), False, 8, process_id=0, process_count=1,
+        drop_remainder=False))
+    assert multi[0][0] == pytest.approx(ref[0], rel=1e-6)
+    assert multi[0][1] == pytest.approx(ref[1], abs=1e-8)
+
+
 def test_cluster_command_generation():
     from dtf_tpu.cli.launch import cluster_commands
     lines = cluster_commands(["python", "train.py", "--x", "1"],
