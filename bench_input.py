@@ -50,13 +50,16 @@ def make_shards(root: str):
 
 
 def main():
+    import sys
     from dtf_tpu.data.imagenet import imagenet_input_fn, native_jpeg_module
+
+    fast_dct = "--fast_dct" in sys.argv
 
     with tempfile.TemporaryDirectory() as root:
         make_shards(root)
         batch = 64
         it = imagenet_input_fn(root, True, batch, seed=0, process_id=0,
-                               process_count=1)
+                               process_count=1, fast_dct=fast_dct)
         # warmup: first batches pay thread spin-up + shuffle-buffer fill
         for _ in range(4):
             next(it)
@@ -78,6 +81,7 @@ def main():
         "cores": cores,
         "per_core": round(per_core, 1),
         "native_batch_decode": native_jpeg_module() is not None,
+        "fast_dct": fast_dct,
         "chip_demand": CHIP_DEMAND,
         "cores_needed_per_chip": round(CHIP_DEMAND / per_core, 1),
     }))

@@ -60,6 +60,10 @@ class Config:
     all_reduce_alg: Optional[str] = None  # --all_reduce_alg (cifar_main.py:104) — advisory on TPU
     num_packs: int = 1                  # --num_packs gradient packing — XLA fuses; advisory
     datasets_num_private_threads: Optional[int] = None  # input pipeline threads
+    # JDCT_IFAST decode in the native train pipeline: ±1-2 LSB vs the
+    # default ISLOW (augmentation-noise territory), measurably faster —
+    # a throughput opt-in, never a default
+    input_fast_dct: bool = False
     per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)
     tf_gpu_thread_mode: Optional[str] = None  # no-op compat
     batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)

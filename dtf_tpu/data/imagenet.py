@@ -223,7 +223,8 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                       seed: int = 0, num_threads: Optional[int] = None,
                       process_id: Optional[int] = None,
                       process_count: Optional[int] = None,
-                      drop_remainder: bool = True) -> Iterator:
+                      drop_remainder: bool = True,
+                      fast_dct: bool = False) -> Iterator:
     """Yields (images float32 [B,224,224,3], labels int32 [B]) — plus a
     float32 validity mask [B] for eval with ``drop_remainder=False``.
 
@@ -349,7 +350,8 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                     bufs.append(buf)
                 images, ok = nj.decode_crop_resize_batch(
                     bufs, crops, flips, DEFAULT_IMAGE_SIZE,
-                    DEFAULT_IMAGE_SIZE, CHANNEL_MEANS, num_threads=1)
+                    DEFAULT_IMAGE_SIZE, CHANNEL_MEANS, num_threads=1,
+                    fast_dct=fast_dct)
                 for j, img in slow.items():
                     images[j] = img
                 for j in np.nonzero(~ok)[0]:

@@ -76,12 +76,17 @@ def decode_batch(bufs, crops, ch: int, cw: int,
 
 
 def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
-                             sub, num_threads: int = 4):
+                             sub, num_threads: int = 4,
+                             fast_dct: bool = False):
     """The whole train-time augmentation for a batch in one C++ call:
     fused decode-and-crop (per-image variable windows) → horizontal
     flip → bilinear resize (half-pixel centers, tf.image.resize v2
     semantics) → channel-mean subtraction, across ``num_threads``
     GIL-free threads.
+
+    ``fast_dct`` selects libjpeg's JDCT_IFAST (±1-2 LSB vs the default
+    ISLOW, measurably faster IDCT) — augmentation-noise territory for
+    training, so it is a throughput opt-in, never a default.
 
     Returns (float32 [n, out_h, out_w, 3], ok mask bool [n]); failed
     images (rare decoder edge cases) have ok=False and undefined
@@ -104,5 +109,5 @@ def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
         sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        num_threads)
+        num_threads, int(fast_dct))
     return out, statuses == 0
