@@ -163,13 +163,22 @@ def preprocess_train(buf: bytes, bbox, rng: np.random.Generator) -> np.ndarray:
 
 
 def preprocess_eval(buf: bytes) -> np.ndarray:
+    """Aspect-preserving resize to shorter side RESIZE_MIN (:438-480) +
+    central crop (:375-394) + mean subtract.  Dispatches to the fused
+    native pass (decode window → one tf-bilinear sampling) when built;
+    Python/PIL fallback below."""
+    nj = native_jpeg_module()
+    if nj is not None and hasattr(nj, "eval_batch"):
+        out, ok = nj.eval_batch([buf], RESIZE_MIN, DEFAULT_IMAGE_SIZE,
+                                DEFAULT_IMAGE_SIZE, CHANNEL_MEANS,
+                                num_threads=1)
+        if ok[0]:
+            return out[0]
     image = decode_jpeg(buf)
     h, w = image.shape[:2]
-    # aspect-preserving resize to shorter side RESIZE_MIN (:438-480)
     scale = RESIZE_MIN / min(h, w)
     nh, nw = int(round(h * scale)), int(round(w * scale))
     resized = _resize_bilinear(image, nh, nw)
-    # central crop (:375-394)
     oy = (nh - DEFAULT_IMAGE_SIZE) // 2
     ox = (nw - DEFAULT_IMAGE_SIZE) // 2
     crop = resized[oy:oy + DEFAULT_IMAGE_SIZE, ox:ox + DEFAULT_IMAGE_SIZE]

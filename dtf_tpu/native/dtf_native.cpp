@@ -258,11 +258,15 @@ int dtf_jpeg_decode_batch(const uint8_t** bufs, const int64_t* lens, int n,
 // way).  Returns the failure count.
 // ---------------------------------------------------------------------------
 
-static void bilinear_resize_sub(const uint8_t* src, int sh, int sw,
+// Generic bilinear sampler: output pixel (r, c) reads source position
+// (y_off + r*y_step, x_off + c*x_step), clamped — tf.image.resize v2
+// semantics when y_off = 0.5*y_step - 0.5 (plain resize), and the
+// aspect-preserving-resize + central-crop composition when the offsets
+// carry the crop origin.
+static void bilinear_sample_sub(const uint8_t* src, int sh, int sw,
                                 float* dst, int oh, int ow, int flip,
-                                const float* sub) {
-  const float sy = static_cast<float>(sh) / oh;
-  const float sx = static_cast<float>(sw) / ow;
+                                float y_off, float y_step, float x_off,
+                                float x_step, const float* sub) {
   // column sampling tables, computed once (not per row)
   std::vector<int> xas(ow), xbs(ow);
   std::vector<float> wxs(ow);
@@ -270,14 +274,14 @@ static void bilinear_resize_sub(const uint8_t* src, int sh, int sw,
     // flip(resize(x)) == resize(flip(x)) for symmetric half-pixel
     // sampling, so the flip fuses into the source column lookup
     int cc = flip ? (ow - 1 - c) : c;
-    float fx = (cc + 0.5f) * sx - 0.5f;
+    float fx = x_off + cc * x_step;
     int x0 = static_cast<int>(floorf(fx));
     wxs[c] = fx - x0;
     xas[c] = 3 * (x0 < 0 ? 0 : (x0 >= sw ? sw - 1 : x0));
     xbs[c] = 3 * (x0 + 1 < 0 ? 0 : (x0 + 1 >= sw ? sw - 1 : x0 + 1));
   }
   for (int r = 0; r < oh; r++) {
-    float fy = (r + 0.5f) * sy - 0.5f;
+    float fy = y_off + r * y_step;
     int y0 = static_cast<int>(floorf(fy));
     float wy = fy - y0;
     int ya = y0 < 0 ? 0 : (y0 >= sh ? sh - 1 : y0);
@@ -322,9 +326,84 @@ int dtf_jpeg_decode_crop_resize_batch(
         failures.fetch_add(1);
         continue;
       }
-      bilinear_resize_sub(tmp.data(), ch, cw,
+      const float ys = static_cast<float>(ch) / oh;
+      const float xs = static_cast<float>(cw) / ow;
+      bilinear_sample_sub(tmp.data(), ch, cw,
                           out + static_cast<size_t>(i) * oh * ow * 3,
-                          oh, ow, flips ? flips[i] : 0, sub);
+                          oh, ow, flips ? flips[i] : 0,
+                          0.5f * ys - 0.5f, ys, 0.5f * xs - 0.5f, xs,
+                          sub);
+      statuses[i] = 0;
+    }
+  };
+  if (num_threads <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < num_threads; t++) threads.emplace_back(work);
+    for (auto& t : threads) t.join();
+  }
+  return failures.load();
+}
+
+// ---------------------------------------------------------------------------
+// Fused eval-side batch: aspect-preserving resize to shorter-side
+// `resize_min` + central [oh, ow] crop + mean-subtract, in ONE sampling
+// pass over a decode WINDOW (only the source rows/cols the crop
+// samples are decoded — imagenet_preprocessing.py:375-394,464-480
+// semantics with tf-bilinear numerics).
+// ---------------------------------------------------------------------------
+
+int dtf_jpeg_eval_batch(const uint8_t** bufs, const int64_t* lens, int n,
+                        int resize_min, int oh, int ow, const float* sub,
+                        float* out, uint8_t* statuses, int num_threads,
+                        int fast_dct) {
+  std::atomic<int> next(0), failures(0);
+  auto work = [&]() {
+    std::vector<uint8_t> tmp;
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      int h = 0, w = 0;
+      if (dtf_jpeg_shape(bufs[i], lens[i], &h, &w) || h <= 0 || w <= 0) {
+        statuses[i] = 1;
+        failures.fetch_add(1);
+        continue;
+      }
+      const float scale =
+          static_cast<float>(resize_min) / (h < w ? h : w);
+      const int nh = static_cast<int>(lroundf(h * scale));
+      const int nw = static_cast<int>(lroundf(w * scale));
+      if (nh < oh || nw < ow) {  // resize_min must cover the crop
+        statuses[i] = 1;
+        failures.fetch_add(1);
+        continue;
+      }
+      const float ys = static_cast<float>(h) / nh;
+      const float xs = static_cast<float>(w) / nw;
+      const float y_off = ((nh - oh) / 2 + 0.5f) * ys - 0.5f;
+      const float x_off = ((nw - ow) / 2 + 0.5f) * xs - 0.5f;
+      // source window actually sampled (clamp handles the edges)
+      int y0 = static_cast<int>(floorf(y_off));
+      int y1 = static_cast<int>(floorf(y_off + (oh - 1) * ys)) + 1;
+      int x0 = static_cast<int>(floorf(x_off));
+      int x1 = static_cast<int>(floorf(x_off + (ow - 1) * xs)) + 1;
+      y0 = y0 < 0 ? 0 : y0;
+      x0 = x0 < 0 ? 0 : x0;
+      y1 = y1 >= h ? h - 1 : y1;
+      x1 = x1 >= w ? w - 1 : x1;
+      const int wh = y1 - y0 + 1, ww = x1 - x0 + 1;
+      tmp.resize(static_cast<size_t>(wh) * ww * 3);
+      if (jpeg_decode_crop_impl(bufs[i], lens[i], y0, x0, wh, ww,
+                                tmp.data(), fast_dct)) {
+        statuses[i] = 1;
+        failures.fetch_add(1);
+        continue;
+      }
+      bilinear_sample_sub(tmp.data(), wh, ww,
+                          out + static_cast<size_t>(i) * oh * ow * 3,
+                          oh, ow, /*flip=*/0, y_off - y0, ys,
+                          x_off - x0, xs, sub);
       statuses[i] = 0;
     }
   };

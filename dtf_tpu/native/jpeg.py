@@ -111,3 +111,30 @@ def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         num_threads, int(fast_dct))
     return out, statuses == 0
+
+
+def eval_batch(bufs, resize_min: int, out_h: int, out_w: int, sub,
+               num_threads: int = 4, fast_dct: bool = False):
+    """Fused eval preprocessing for a batch: aspect-preserving resize to
+    shorter-side ``resize_min`` + central [out_h, out_w] crop +
+    channel-mean subtraction in one sampling pass over a decode window
+    (only the needed source rows/cols are decoded).  tf-bilinear
+    numerics — the reference's eval path
+    (imagenet_preprocessing.py:375-394,464-480).
+
+    Returns (float32 [n, out_h, out_w, 3], ok mask bool [n]).
+    """
+    lib = _lib()
+    n = len(bufs)
+    out = np.empty((n, out_h, out_w, 3), np.float32)
+    statuses = np.empty((n,), np.uint8)
+    buf_ptrs = (ctypes.c_char_p * n)(*bufs)
+    lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
+    sub_arr = np.ascontiguousarray(np.asarray(sub, np.float32))
+    lib.dtf_jpeg_eval_batch(
+        buf_ptrs, lens, n, resize_min, out_h, out_w,
+        sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        num_threads, int(fast_dct))
+    return out, statuses == 0

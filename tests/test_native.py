@@ -155,6 +155,41 @@ def test_decode_crop_resize_batch_matches_reference():
         np.testing.assert_allclose(out[i], want, atol=2e-3)
 
 
+def test_eval_batch_matches_reference():
+    """Fused eval pass (window decode + one sampling) ≡ full decode →
+    tf-bilinear aspect resize → central crop → mean subtract."""
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(21)
+    sub = np.array([123.68, 116.78, 103.94], np.float32)
+    bufs = []
+    for h, w in [(300, 400), (400, 300), (256, 256), (260, 513)]:
+        bufs.append(_jpeg(rng.integers(0, 256, (h, w, 3), dtype=np.uint8)))
+    out, ok = jpeg.eval_batch(bufs, 256, 224, 224, sub, num_threads=2)
+    assert ok.all() and out.shape == (4, 224, 224, 3)
+    for i, buf in enumerate(bufs):
+        img = jpeg.decode(buf)
+        h, w = img.shape[:2]
+        scale = 256 / min(h, w)
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+        resized = _tf_bilinear(img, nh, nw)
+        oy, ox = (nh - 224) // 2, (nw - 224) // 2
+        want = resized[oy:oy + 224, ox:ox + 224] - sub
+        # float32 association differs between the C++ single-pass and
+        # the numpy reference; 0.02 on a 0..255 scale is rounding noise
+        np.testing.assert_allclose(out[i], want, atol=2e-2)
+
+
+def test_eval_batch_rejects_tiny_images():
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(22)
+    buf = _jpeg(rng.integers(0, 256, (40, 40, 3), dtype=np.uint8))
+    # shorter side scales to 256, but a crop larger than resize_min
+    # cannot be served
+    out, ok = jpeg.eval_batch([buf], 128, 224, 224,
+                              np.zeros(3, np.float32))
+    assert not ok[0]
+
+
 def test_decode_crop_resize_batch_fast_dct_close():
     """JDCT_IFAST is a throughput opt-in: same shapes, pixel values
     within a couple of LSB of the default ISLOW decode."""
