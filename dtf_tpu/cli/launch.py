@@ -243,11 +243,18 @@ def main(argv=None) -> int:
                 if rc == 0:
                     rc = ret
         return rc
+    # startup_grace default: 300 s covers first-compile stalls, but an
+    # operator who explicitly set a SHORTER --heartbeat_timeout wants
+    # hangs caught on that clock from the start — so the unset-grace
+    # default follows the explicit timeout downward (never upward: a
+    # long steady-state timeout must not weaken startup detection).
+    if startup_grace is None:
+        startup_grace = (min(heartbeat_timeout, 300.0)
+                         if heartbeat_timeout else 300.0)
     return launch_local(cmd, num_processes, coordinator, log_dir,
                         devices_per_process, max_restarts=max_restarts,
                         heartbeat_timeout=heartbeat_timeout,
-                        startup_grace=(300.0 if startup_grace is None
-                                       else startup_grace))
+                        startup_grace=startup_grace)
 
 
 if __name__ == "__main__":

@@ -46,9 +46,11 @@ class Conv1SpaceToDepth(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        kernel = self.param("kernel", conv_init, (7, 7, 3, 64),
-                            jnp.float32)
         b, h, w, c = x.shape
+        assert c == 3, (f"Conv1SpaceToDepth is the RGB stem; got "
+                        f"{c}-channel input")
+        kernel = self.param("kernel", conv_init, (7, 7, c, self.features),
+                            jnp.float32)
         x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
         # 2×2 space-to-depth: [B, (H+6)/2, (W+6)/2, 12]
         hb, wb = (h + 6) // 2, (w + 6) // 2

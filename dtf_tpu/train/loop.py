@@ -283,7 +283,15 @@ class Trainer:
             # Init under jit with sharded out_shardings so the full
             # state never materializes on one device (the transient
             # spike would OOM exactly the model sizes this targets)
-            from dtf_tpu.train.optimizer import opt_state_specs
+            from dtf_tpu.train.optimizer import (ZEROS_INIT_OPTIMIZERS,
+                                                 opt_state_specs)
+            # This proto trick only holds for value-independent inits
+            # (state is zeros whatever the params are) — enforced so a
+            # future optimizer can't silently get wrong ZeRO state.
+            assert self.cfg.optimizer in ZEROS_INIT_OPTIMIZERS, (
+                f"ZeRO init uses zero-valued protos; optimizer "
+                f"{self.cfg.optimizer!r} is not registered as having a "
+                f"value-independent init (optimizer.ZEROS_INIT_OPTIMIZERS)")
             is_p = lambda x: isinstance(x, P)
             nd = self.rt.mesh.shape[DATA_AXIS]
             mesh_shape = dict(self.rt.mesh.shape)

@@ -75,6 +75,16 @@ def adamw(learning_rate: Callable, b1: float = 0.9, b2: float = 0.95,
     return optax.GradientTransformation(init, update)
 
 
+# Optimizers whose init() depends only on param SHAPES/dtypes (their
+# state is zeros regardless of param values).  The ZeRO path exploits
+# this: it calls tx.init on zero-valued protos of the *flattened
+# padded* shard layout instead of materializing full-size params
+# (train/loop.py).  Any optimizer whose init reads param VALUES
+# (e.g. LARS trust-ratio snapshots, Shampoo preconditioner seeds) must
+# NOT be added here without also fixing that call site.
+ZEROS_INIT_OPTIMIZERS = frozenset({"sgd", "momentum", "adamw"})
+
+
 def build_optimizer(name: str, learning_rate: Callable,
                     momentum: float = 0.9) -> optax.GradientTransformation:
     if name in ("sgd", "momentum"):
