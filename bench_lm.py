@@ -1,0 +1,284 @@
+"""LM benchmark: transformer training throughput + kernel/pipeline micro-numbers.
+
+Every LM performance number quoted in README.md / docs/DESIGN.md is
+produced by this script, so the driver (and anyone else) can re-measure
+and regression-track them.  Prints ONE JSON line per invocation,
+bench.py contract: {"metric", "value", "unit", "vs_baseline", ...}.
+The reference workload is vision-only (SURVEY §5.7) so there is no
+reference LM baseline; ``vs_baseline`` tracks round-over-round against
+the r2 recorded number instead.
+
+Variants:
+  python bench_lm.py                  # headline: GPT-2-small-class train step
+  python bench_lm.py --remat          # same with jax.checkpoint per block
+  python bench_lm.py --variant flash  # Pallas kernel micro: fwd ms, bwd/fwd
+  python bench_lm.py --variant gpipe  # GPipe M-scaling on the 8-dev CPU mesh
+
+Headline model: 12×768, 12 heads, d_ff 3072, seq 2048, vocab 32k
+(≈137 M params), bf16 activations, AdamW, flash-attention Pallas
+kernels — the long-context flagship (docs/DESIGN.md).  MFU is XLA's
+own flop count for the compiled step over the chip's peak bf16
+FLOP/s (same convention as bench.py); `mfu_6n` is the classic
+6·N·tokens/s estimate for cross-checking.
+"""
+
+import json
+import os
+import sys
+
+# The gpipe variant measures a relative pipeline schedule, which needs
+# >=2 devices — force the 8-virtual-device CPU mesh before jax import.
+if "--variant" in sys.argv and "gpipe" in sys.argv:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8")
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import is_oom, peak_tflops  # shared helpers
+
+# r2 recorded numbers (README.md) — round-over-round baselines.
+R2_TOKENS_PER_SEC = 99_000.0
+R2_REMAT_TOKENS_PER_SEC = 81_000.0
+R2_FLASH_BWD_OVER_FWD = 0.70
+R2_GPIPE_SPEEDUP = 1.62
+
+SEQ = 2048
+VOCAB = 32_768
+
+
+def _sync(x):
+    return float(jax.device_get(x))
+
+
+def build_trainer(batch: int, remat: bool):
+    from dtf_tpu.config import Config
+    from dtf_tpu.data.base import LM
+    from dtf_tpu.models import build_model
+    from dtf_tpu.runtime import initialize
+    from dtf_tpu.train import Trainer
+
+    cfg = Config(model="transformer", dataset="lm", dtype="bf16",
+                 batch_size=batch, distribution_strategy="tpu",
+                 optimizer="adamw", skip_eval=True, train_steps=1,
+                 remat=remat)
+    rt = initialize(cfg)
+    rt.shard_seq = True
+    model, _ = build_model("transformer", num_classes=VOCAB,
+                           dtype=jnp.bfloat16, num_layers=12, d_model=768,
+                           num_heads=12, d_ff=3072, max_seq_len=SEQ,
+                           remat=remat)
+    trainer = Trainer(cfg, rt, model, 0.0, LM)
+    return trainer, rt
+
+
+def train_bench(remat: bool, warmup: int = 3, iters: int = 10):
+    n_chips = len(jax.devices())
+    err = None
+    for per_chip in (16, 8, 4):
+        batch = per_chip * n_chips
+        try:
+            trainer, rt = build_trainer(batch, remat)
+            rng = np.random.default_rng(0)
+            tokens = rng.integers(0, VOCAB, (batch, SEQ)).astype(np.int32)
+            labels = np.roll(tokens, -1, axis=1)
+            state = trainer.init_state(jax.random.key(0), (tokens, labels))
+            sharded = rt.shard_batch((tokens, labels))
+
+            step_flops = None
+            try:
+                ca = trainer.train_step.lower(
+                    state, *sharded).compile().cost_analysis()
+                ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                step_flops = float(ca.get("flops", 0.0)) or None
+            except Exception:
+                pass
+            n_params = sum(x.size for x in
+                           jax.tree_util.tree_leaves(state.params))
+
+            for _ in range(warmup):
+                state, metrics = trainer.train_step(state, *sharded)
+            _sync(metrics["loss"])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, metrics = trainer.train_step(state, *sharded)
+            loss = _sync(metrics["loss"])
+            elapsed = time.perf_counter() - t0
+            assert np.isfinite(loss), f"non-finite loss {loss}"
+
+            step_s = elapsed / iters
+            tokens_per_sec = batch * SEQ / step_s
+            per_chip_tps = tokens_per_sec / n_chips
+            peak = peak_tflops(jax.devices()[0])
+            mfu = ((step_flops / step_s) / (peak * 1e12)
+                   if step_flops and peak else None)
+            mfu_6n = ((6.0 * n_params * per_chip_tps) / (peak * 1e12)
+                      if peak else None)
+            return dict(per_chip_tps=per_chip_tps, step_ms=step_s * 1e3,
+                        mfu=mfu, mfu_6n=mfu_6n, n_params=n_params,
+                        per_chip_batch=per_chip, n_chips=n_chips)
+        except Exception as e:
+            if not is_oom(e):
+                raise
+            err = e
+    raise err
+
+
+def flash_bench(seq: int = 8192, warmup: int = 3, iters: int = 10):
+    """Kernel micro: Pallas flash fwd vs bwd wall time, [2, seq, 8, 128]
+    bf16 causal — the shape quoted in ops/flash_attention.py."""
+    from dtf_tpu.ops.flash_attention import flash_attention
+
+    rng = jax.random.key(0)
+    qk, kk, vk = jax.random.split(rng, 3)
+    shape = (2, seq, 8, 128)
+    q = jax.random.normal(qk, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(vk, shape, jnp.bfloat16)
+
+    fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32))
+
+    grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def timed(fn, *args):
+        out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0][0, 0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0][0, 0, 0, 0])
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    for _ in range(warmup):
+        fwd(q, k, v)
+    fwd_ms = timed(fwd, q, k, v)
+    for _ in range(warmup):
+        grad(q, k, v)
+    # grad-of-sum re-runs the forward then the two backward kernels;
+    # bwd-only time is the difference
+    fwdbwd_ms = timed(grad, q, k, v)
+    bwd_ms = max(fwdbwd_ms - fwd_ms, 0.0)
+    return dict(fwd_ms=fwd_ms, bwd_ms=bwd_ms,
+                bwd_over_fwd=bwd_ms / fwd_ms if fwd_ms else None,
+                seq=seq, shape=list(shape))
+
+
+def gpipe_bench(pp: int = 4, warmup: int = 2, iters: int = 5):
+    """Relative schedule measurement on the virtual CPU mesh: step time
+    at M = pp (worst bubble) vs the auto-scaled M = 4·pp.  Absolute CPU
+    times are meaningless; the ratio is the bubble-reduction claim."""
+    import functools
+
+    from dtf_tpu.config import Config
+    from dtf_tpu.data.base import DatasetSpec
+    from dtf_tpu.models.pipeline_lm import (PipelinedTransformerLM,
+                                            pipeline_param_partition_specs)
+    from dtf_tpu.runtime.mesh import MESH_AXES, MODEL_AXIS, MeshRuntime
+    from dtf_tpu.train import Trainer
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    assert len(devices) >= pp, f"need {pp} devices, have {len(devices)}"
+    dp = len(devices) // pp
+    mesh = Mesh(np.array(devices[:dp * pp]).reshape(dp, 1, pp), MESH_AXES)
+    seq, vocab, batch = 128, 512, dp * 16
+    spec = DatasetSpec("lm", 0, 0, vocab, 1024, 128, one_hot=False,
+                       seq_len=seq)
+
+    def step_time(m):
+        rt = MeshRuntime(mesh=mesh, strategy="mirrored", shard_seq=True)
+        cfg = Config(model="pipeline_transformer", dataset="lm",
+                     batch_size=batch, train_steps=1, skip_eval=True,
+                     optimizer="adamw")
+        model = PipelinedTransformerLM(
+            vocab_size=vocab, num_layers=2 * pp, d_model=64, num_heads=4,
+            d_ff=256, max_seq_len=seq, num_microbatches=m,
+            pipe_axis=MODEL_AXIS)
+        trainer = Trainer(cfg, rt, model, 0.0, spec,
+                          param_spec_fn=functools.partial(
+                              pipeline_param_partition_specs,
+                              pipe_axis=MODEL_AXIS))
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+        labels = np.roll(tokens, -1, axis=1)
+        state = trainer.init_state(jax.random.key(0), (tokens, labels))
+        sharded = rt.shard_batch((tokens, labels))
+        for _ in range(warmup):
+            state, metrics = trainer.train_step(state, *sharded)
+        _sync(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = trainer.train_step(state, *sharded)
+        _sync(metrics["loss"])
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    worst = step_time(pp)        # bubble (pp-1)/(2pp-1) = 3/7 at pp=4
+    best = step_time(4 * pp)     # bubble (pp-1)/(5pp-1) = 3/19 at pp=4
+    return dict(pp=pp, m_low=pp, m_high=4 * pp,
+                step_ms_m_low=round(worst, 1),
+                step_ms_m_high=round(best, 1),
+                speedup=worst / best)
+
+
+def main():
+    variant = None
+    if "--variant" in sys.argv:
+        variant = sys.argv[sys.argv.index("--variant") + 1]
+    remat = "--remat" in sys.argv
+
+    if variant == "flash":
+        r = flash_bench()
+        print(json.dumps({
+            "metric": "flash_attention_bwd_over_fwd",
+            "value": round(r["bwd_over_fwd"], 3),
+            "unit": "ratio",
+            "vs_baseline": round(r["bwd_over_fwd"] / R2_FLASH_BWD_OVER_FWD, 2),
+            "fwd_ms": round(r["fwd_ms"], 2), "bwd_ms": round(r["bwd_ms"], 2),
+            "seq": r["seq"], "shape": r["shape"],
+            "device_kind": jax.devices()[0].device_kind,
+        }))
+        return
+    if variant == "gpipe":
+        r = gpipe_bench()
+        print(json.dumps({
+            "metric": "gpipe_m_scaling_speedup",
+            "value": round(r["speedup"], 2),
+            "unit": "x (step time, M=4pp vs M=pp)",
+            "vs_baseline": round(r["speedup"] / R2_GPIPE_SPEEDUP, 2),
+            "pp": r["pp"], "m_low": r["m_low"], "m_high": r["m_high"],
+            "step_ms_m_low": r["step_ms_m_low"],
+            "step_ms_m_high": r["step_ms_m_high"],
+            "backend": jax.default_backend(),
+        }))
+        return
+
+    r = train_bench(remat)
+    base = R2_REMAT_TOKENS_PER_SEC if remat else R2_TOKENS_PER_SEC
+    print(json.dumps({
+        "metric": ("lm_tokens_per_sec_per_chip_remat" if remat
+                   else "lm_tokens_per_sec_per_chip"),
+        "value": round(r["per_chip_tps"], 0),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(r["per_chip_tps"] / base, 2),
+        "step_ms": round(r["step_ms"], 2),
+        "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
+        "mfu_6n": round(r["mfu_6n"], 4) if r["mfu_6n"] is not None else None,
+        "n_params": r["n_params"],
+        "per_chip_batch": r["per_chip_batch"],
+        "n_chips": r["n_chips"],
+        "seq_len": SEQ,
+        "remat": remat,
+        "device_kind": jax.devices()[0].device_kind,
+    }))
+
+
+if __name__ == "__main__":
+    main()
