@@ -6,13 +6,25 @@ JPEG shards, with no device in the loop.  Prints ONE JSON line:
 
   value            images/sec sustained by this host
   per_core         value / cpu cores (the portable number)
+  serial_fraction  GIL-held Python share of each batch (parse + crop
+                   sampling) — this work serializes across worker
+                   threads, so it bounds multi-core scaling
+  amdahl_ceiling_images_per_sec_per_host
+                   batch_size / py_s_per_batch — the host rate at which
+                   the serial Python share alone saturates one core,
+                   regardless of core count
   chip_demand      what one TPU chip consumes at bench.py speed
   cores_needed     chip_demand / per_core — host provisioning guide
+                   (valid while chip_demand < amdahl ceiling)
+
+Flags: --fast_dct (JDCT_IFAST decode), --scaled_decode (DCT-space
+1/2-1/8 decode for crops >=2x the target).
 
 The reference's equivalent number: its pipeline fed ~168.6 img/s per
 P40 with tf.data's C++ kernels (ps_server/log1.log).  A multi-core TPU
 host must feed ~2,400+ img/s per chip (BENCH_r02); this bench proves
-the per-core rate and therefore the core count that achieves it.
+the per-core rate, the core count that achieves it, and (r3) the
+measured Amdahl bound that the linear-scaling assumption rests on.
 """
 
 import io
@@ -54,15 +66,19 @@ def main():
     from dtf_tpu.data.imagenet import imagenet_input_fn, native_jpeg_module
 
     fast_dct = "--fast_dct" in sys.argv
+    scaled_decode = "--scaled_decode" in sys.argv
 
+    stats: dict = {}
     with tempfile.TemporaryDirectory() as root:
         make_shards(root)
         batch = 64
         it = imagenet_input_fn(root, True, batch, seed=0, process_id=0,
-                               process_count=1, fast_dct=fast_dct)
+                               process_count=1, fast_dct=fast_dct,
+                               scaled_decode=scaled_decode, stats=stats)
         # warmup: first batches pay thread spin-up + shuffle-buffer fill
         for _ in range(4):
             next(it)
+        stats.clear()
         t0 = time.perf_counter()
         seen = 0
         while seen < MEASURE_IMAGES:
@@ -74,6 +90,12 @@ def main():
     cores = os.cpu_count() or 1
     rate = seen / elapsed
     per_core = rate / cores
+    serial_fraction = amdahl = None
+    if stats.get("batches"):
+        py_per_batch = stats["py_s"] / stats["batches"]
+        native_per_batch = stats["native_s"] / stats["batches"]
+        serial_fraction = py_per_batch / (py_per_batch + native_per_batch)
+        amdahl = batch / py_per_batch
     print(json.dumps({
         "metric": "imagenet_input_pipeline_images_per_sec_per_host",
         "value": round(rate, 1),
@@ -82,6 +104,11 @@ def main():
         "per_core": round(per_core, 1),
         "native_batch_decode": native_jpeg_module() is not None,
         "fast_dct": fast_dct,
+        "scaled_decode": scaled_decode,
+        "serial_fraction": (round(serial_fraction, 4)
+                            if serial_fraction is not None else None),
+        "amdahl_ceiling_images_per_sec_per_host": (
+            round(amdahl, 0) if amdahl is not None else None),
         "chip_demand": CHIP_DEMAND,
         "cores_needed_per_chip": round(CHIP_DEMAND / per_core, 1),
     }))

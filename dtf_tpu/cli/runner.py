@@ -70,7 +70,8 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
             lambda: imagenet_input_fn(cfg.data_dir, True, host_batch,
                                       seed=cfg.seed,
                                       num_threads=cfg.datasets_num_private_threads,
-                                      fast_dct=cfg.input_fast_dct),
+                                      fast_dct=cfg.input_fast_dct,
+                                      scaled_decode=cfg.input_scaled_decode),
             lambda: imagenet_input_fn(cfg.data_dir, False, host_batch,
                                       drop_remainder=cfg.drop_remainder),
         )

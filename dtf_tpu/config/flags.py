@@ -64,6 +64,11 @@ class Config:
     # default ISLOW (augmentation-noise territory), measurably faster —
     # a throughput opt-in, never a default
     input_fast_dct: bool = False
+    # DCT-space 1/2–1/8 scaled decode (libjpeg scale_denom) for train
+    # crops >=2x the output size: skips most IDCT work on large crops.
+    # Changes the downsampling filter chain (scaled decode + bilinear
+    # vs pure bilinear) — another throughput opt-in, never a default
+    input_scaled_decode: bool = False
     per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)
     tf_gpu_thread_mode: Optional[str] = None  # no-op compat
     batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)

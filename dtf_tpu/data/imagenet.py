@@ -233,9 +233,18 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                       process_id: Optional[int] = None,
                       process_count: Optional[int] = None,
                       drop_remainder: bool = True,
-                      fast_dct: bool = False) -> Iterator:
+                      fast_dct: bool = False,
+                      scaled_decode: bool = False,
+                      stats: Optional[dict] = None) -> Iterator:
     """Yields (images float32 [B,224,224,3], labels int32 [B]) — plus a
     float32 validity mask [B] for eval with ``drop_remainder=False``.
+
+    ``stats``: pass a dict to collect per-batch timing from the native
+    train path — keys py_s (GIL-held Python work: Example parse, crop
+    sampling), native_s (GIL-released fused C++ decode) and batches are
+    accumulated in place.  The Python share serializes across worker
+    threads, so py_s per batch is the Amdahl floor on multi-core
+    scaling (bench_input.py reports the derived ceiling).
 
     Eval modes:
       - ``drop_remainder=False`` (config default): eval FILES are
@@ -271,6 +280,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     raw_q: queue.Queue = queue.Queue(maxsize=SHUFFLE_BUFFER // 4)
     out_q: queue.Queue = queue.Queue(maxsize=64)
     stop = threading.Event()
+    stats_lock = threading.Lock()
 
     # Batched native fast path (train only): the reader's shuffle buffer
     # emits whole-batch CHUNKS of raw records, and each Python worker
@@ -335,6 +345,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
 
     def batch_worker(wid: int):
         """Parse + crop-sample + fused-decode one whole batch."""
+        import time as _time
         wrng = np.random.default_rng(seed + 104729 * (process_id + 1) + wid)
         while True:
             chunk = raw_q.get()
@@ -342,6 +353,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 out_q.put(None)
                 return
             try:
+                t0 = _time.perf_counter()
                 bufs, labels, crops, flips, slow = [], [], [], [], {}
                 for raw in chunk:
                     buf, label, bbox = parse_example_record(raw)
@@ -357,10 +369,20 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                         crops.append((0, 0, 1, 1))
                         flips.append(False)
                     bufs.append(buf)
+                t1 = _time.perf_counter()
                 images, ok = nj.decode_crop_resize_batch(
                     bufs, crops, flips, DEFAULT_IMAGE_SIZE,
                     DEFAULT_IMAGE_SIZE, CHANNEL_MEANS, num_threads=1,
-                    fast_dct=fast_dct)
+                    fast_dct=fast_dct, scaled_decode=scaled_decode)
+                t2 = _time.perf_counter()
+                if stats is not None:
+                    # dict read-modify-write is NOT atomic across
+                    # threads — serialize the accumulation
+                    with stats_lock:
+                        stats["py_s"] = stats.get("py_s", 0.0) + (t1 - t0)
+                        stats["native_s"] = (stats.get("native_s", 0.0)
+                                             + (t2 - t1))
+                        stats["batches"] = stats.get("batches", 0) + 1
                 for j, img in slow.items():
                     images[j] = img
                 for j in np.nonzero(~ok)[0]:
@@ -389,10 +411,50 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 out_q.put(e)
                 return
 
-    threading.Thread(target=reader, daemon=True).start()
-    for w in range(num_threads):
-        threading.Thread(target=batch_worker if batch_native else worker,
-                         args=(w,), daemon=True).start()
+    threads = [threading.Thread(target=reader, daemon=True)]
+    threads += [threading.Thread(target=batch_worker if batch_native
+                                 else worker, args=(w,), daemon=True)
+                for w in range(num_threads)]
+    for t in threads:
+        t.start()
+
+    def _shutdown():
+        """Interpreter-exit backstop: if the process exits while a
+        daemon worker is inside the GIL-released C++ decode, CPython
+        force-unwinds the thread (pthread_exit) when the foreign call
+        returns — which aborts through the C++ frames (glibc
+        'FATAL: exception not rethrown').  Stop the pipeline and wait
+        for in-flight decodes instead."""
+        stop.set()
+        for q in (raw_q, out_q):  # unblock producers stuck on put()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        for _ in range(num_threads):  # wake workers stuck on get()
+            try:
+                raw_q.put_nowait(None)
+            except queue.Full:
+                break
+        for t in threads:
+            t.join(timeout=5.0)
+
+    # Registered per pipeline, unregistered when the consuming
+    # generator is exhausted or closed — a long test session creating
+    # many iterators must not accumulate handlers (each pins its
+    # queues/threads until process exit).
+    import atexit
+    atexit.register(_shutdown)
+
+    def _teardown():
+        stop.set()
+        atexit.unregister(_shutdown)
+        for _ in range(num_threads):  # let workers drain out promptly
+            try:
+                raw_q.put_nowait(None)
+            except queue.Full:
+                break
 
     def gen_native():
         done_workers = 0
@@ -406,7 +468,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                     raise item
                 yield item
         finally:
-            stop.set()
+            _teardown()
 
     def gen():
         images = np.empty((batch_size, DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE,
@@ -445,6 +507,6 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                     filled = 0
                     yielded += 1
         finally:
-            stop.set()
+            _teardown()
 
     return gen_native() if batch_native else gen()

@@ -55,7 +55,8 @@ def load() -> Optional[ctypes.CDLL]:
     lib.dtf_jpeg_decode_crop_resize_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int, ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_int,
-        ctypes.c_int, f32p, f32p, u8p, ctypes.c_int, ctypes.c_int]
+        ctypes.c_int, f32p, f32p, u8p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int]
     lib.dtf_jpeg_decode_crop_resize_batch.restype = ctypes.c_int
     lib.dtf_jpeg_eval_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),

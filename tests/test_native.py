@@ -207,6 +207,67 @@ def test_decode_crop_resize_batch_fast_dct_close():
     np.testing.assert_allclose(fast, slow, atol=12.0)
 
 
+def test_decode_crop_resize_batch_scaled_decode():
+    """--input_scaled_decode: crops larger than the output decode at
+    the smallest N/8 DCT-space scale keeping the scaled crop >= the
+    output — numerically close to the full decode on real (smooth)
+    content, and bit-identical when the crop is not larger than the
+    output."""
+    from dtf_tpu.native import jpeg
+    # smooth content (JPEG's home turf): gradients + a low-freq wave
+    yy, xx = np.mgrid[0:512, 0:640].astype(np.float32)
+    img = np.stack([
+        96 + 64 * np.sin(yy / 70) + 0.05 * xx,
+        128 + 0.15 * yy,
+        80 + 48 * np.cos(xx / 90),
+    ], axis=-1).clip(0, 255).astype(np.uint8)
+    buf = _jpeg(img)
+    sub = np.zeros(3, np.float32)
+    big = [(10, 20, 480, 600)]  # → N=4 (4/8 = half-res decode)
+    for flip in (0, 1):
+        plain, ok1 = jpeg.decode_crop_resize_batch(
+            [buf], big, [flip], 224, 224, sub)
+        scaled, ok2 = jpeg.decode_crop_resize_batch(
+            [buf], big, [flip], 224, 224, sub, scaled_decode=True)
+        assert ok1.all() and ok2.all()
+        # the scaled path must actually engage (bit-identical output
+        # would mean the flag is dead) ...
+        assert np.any(scaled != plain)
+        # ... while the filter-chain difference stays tightly bounded
+        # on smooth content, tiny in the mean
+        assert np.abs(scaled - plain).max() < 8.0
+        assert np.abs(scaled - plain).mean() < 1.0
+    # N=5..7 scales are a measured loss (no SIMD reduced IDCT) — a
+    # 300px crop (would-be N=6) must take the plain path bit-for-bit
+    small = [(0, 0, 300, 300)]
+    a, _ = jpeg.decode_crop_resize_batch([buf], small, [0], 224, 224, sub)
+    b, _ = jpeg.decode_crop_resize_batch([buf], small, [0], 224, 224, sub,
+                                         scaled_decode=True)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_decode_crop_resize_batch_scaled_decode_deep():
+    """A very large crop picks a deep scale (here 2/8 = quarter-res)
+    and still lands near the unscaled result."""
+    from dtf_tpu.native import jpeg
+    yy, xx = np.mgrid[0:1200, 0:1400].astype(np.float32)
+    img = np.stack([
+        100 + 0.08 * yy, 120 + 0.05 * xx, 90 + 40 * np.sin(yy / 200),
+    ], axis=-1).clip(0, 255).astype(np.uint8)
+    buf = _jpeg(img)
+    sub = np.zeros(3, np.float32)
+    crops = [(4, 8, 1180, 1380)]  # >= 4x 224 → d=4
+    plain, ok1 = jpeg.decode_crop_resize_batch([buf], crops, [0], 224,
+                                               224, sub)
+    scaled, ok2 = jpeg.decode_crop_resize_batch([buf], crops, [0], 224,
+                                                224, sub,
+                                                scaled_decode=True)
+    assert ok1.all() and ok2.all()
+    assert np.any(scaled != plain)  # the deep scale must engage
+    assert np.abs(scaled - plain).max() < 8.0
+    assert np.abs(scaled - plain).mean() < 1.0
+
+
 def test_decode_crop_resize_batch_flags_bad_images():
     from dtf_tpu.native import jpeg
     rng = np.random.default_rng(12)
