@@ -161,8 +161,14 @@ def run(cfg: Config) -> dict:
         if expert_on_model:
             model_kw["expert_axis_along_batch"] = False
     elif is_pipeline:
+        if cfg.pipeline_interleave > 1:
+            if pipe_axis is None:
+                raise ValueError(
+                    "--pipeline_interleave > 1 needs pipeline stages: "
+                    "set --model_parallelism > 1")
+            model_kw["interleave"] = cfg.pipeline_interleave
         if cfg.num_microbatches is not None:
-            model_kw = dict(num_microbatches=cfg.num_microbatches)
+            model_kw = dict(model_kw, num_microbatches=cfg.num_microbatches)
         else:
             # auto-scale the GPipe schedule: bubble fraction is
             # (pp-1)/(M+pp-1), so target M = 4·pp (≤20% bubble) and
@@ -172,7 +178,7 @@ def run(cfg: Config) -> dict:
             m = 4 * pp
             while m > 1 and per_shard % m:
                 m //= 2
-            model_kw = dict(num_microbatches=max(m, 1))
+            model_kw = dict(model_kw, num_microbatches=max(m, 1))
     if cfg.remat:
         if not model_name.startswith(
                 ("transformer", "moe_transformer", "pipeline_transformer")):

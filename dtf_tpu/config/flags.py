@@ -120,6 +120,9 @@ class Config:
     ps_mode: str = "sync"               # parameter_server flavor: sync SPMD
                                         # (north star) | async (C++ param
                                         # store, capability-exact, parallel/ps)
+    ps_wire: str = "fp32"               # async-PS wire format: fp32 | bf16
+                                        # (bf16 halves pull/push traffic;
+                                        # store math stays fp32)
     num_devices: Optional[int] = None   # ≈ --num_gpus: local chips to use; None = all
     worker_hosts: Optional[str] = None  # --worker_hosts "h1:p,h2:p" (imagenet_main.py:108-110)
     task_index: int = -1                # --task_index
@@ -144,6 +147,10 @@ class Config:
     moe_top_k: Optional[int] = None     # router choices: 1=Switch, 2=GShard
     # --- pipeline parallelism (pipeline_transformer family) ---
     num_microbatches: Optional[int] = None  # GPipe microbatches per step
+    # 2 = two virtual stages per device (Megatron interleaving): halves
+    # the fill/drain bubble at equal num_microbatches for the cost of
+    # 2x ppermute hops (models/pipeline_lm.py docstring)
+    pipeline_interleave: int = 1
 
     # --- optimizer ---
     optimizer: str = "sgd"              # sgd (reference, common.py:169-172)
@@ -185,6 +192,13 @@ class Config:
                 f"choose from {STRATEGIES}")
         if self.dtype not in DTYPES:
             raise ValueError(f"unknown dtype {self.dtype!r}; choose from {DTYPES}")
+        if self.pipeline_interleave not in (1, 2):
+            raise ValueError(
+                f"pipeline_interleave must be 1 or 2, got "
+                f"{self.pipeline_interleave}")
+        if self.ps_wire not in ("fp32", "bf16"):
+            raise ValueError(
+                f"unknown ps_wire {self.ps_wire!r}; choose fp32 or bf16")
         if self.ps_mode not in ("sync", "async"):
             raise ValueError(
                 f"unknown ps_mode {self.ps_mode!r}; choose sync or async")

@@ -50,7 +50,8 @@ from jax import lax
 
 from dtf_tpu.ops.flash_attention import flash_attention
 from dtf_tpu.parallel.collectives import tp_region
-from dtf_tpu.parallel.pipeline import last_stage_broadcast, pipeline_spmd
+from dtf_tpu.parallel.pipeline import (last_stage_broadcast, pipeline_spmd,
+                                       pipeline_spmd_interleaved)
 
 # parameter names that carry a leading stacked-layer dimension
 BLOCK_PARAMS = ("ln1_s", "ln1_b", "qkv_k", "qkv_b", "out_k", "out_b",
@@ -86,6 +87,15 @@ class PipelinedTransformerLM(nn.Module):
     pipe_axis: Optional[str] = None
     use_pallas: Any = None
     remat: bool = False
+    # interleave=2: two virtual stages per device (Megatron-style) —
+    # the stage's local block stack splits into two chunks and each
+    # microbatch circles the ring twice, halving the fill/drain bubble
+    # at equal num_microbatches (parallel.pipeline docstring).  The
+    # depth-order then visits global layers chunk-interleaved, so the
+    # single-device twin needs `interleave_pp` (the logical pipeline
+    # length) to reproduce the identical visitation order off-mesh.
+    interleave: int = 1
+    interleave_pp: Optional[int] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -150,14 +160,42 @@ class PipelinedTransformerLM(nn.Module):
 
         step = (jax.checkpoint(block_step) if self.remat else block_step)
 
+        if self.interleave not in (1, 2):
+            raise ValueError(f"interleave must be 1 or 2, got "
+                             f"{self.interleave}")
+
         def stage_fn(h):
             # scan over this shard's block stack (leading dim of the
             # received params — full depth off-mesh, depth/pp on it)
             h, _ = lax.scan(lambda c, p: (step(c, p), None), h, blocks)
             return h
 
+        def stage_fn_chunk(h, chunk):
+            # interleaved: run only this lap's half of the local stack
+            local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+            half = local // 2
+            part = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_slice_in_dim(a, chunk * half, half,
+                                                   axis=0), blocks)
+            h, _ = lax.scan(lambda c, p: (step(c, p), None), h, part)
+            return h
+
         x = embed[tokens].astype(dtype) + pos[:s].astype(dtype)
         if self.pipe_axis is None:
+            if self.interleave == 2:
+                # reproduce the interleaved visitation order off-mesh:
+                # lap 0 chunks of every stage, then lap 1 chunks
+                pp = self.interleave_pp
+                if not pp or layers % (2 * pp):
+                    raise ValueError(
+                        "interleave=2 off-mesh needs interleave_pp with "
+                        "num_layers divisible by 2*interleave_pp")
+                per, half = layers // pp, layers // pp // 2
+                order = jnp.array(
+                    [dev * per + lap * half + i
+                     for lap in range(2) for dev in range(pp)
+                     for i in range(half)])
+                blocks = jax.tree_util.tree_map(lambda a: a[order], blocks)
             h = stage_fn(x)
         else:
             if b % self.num_microbatches:
@@ -168,9 +206,16 @@ class PipelinedTransformerLM(nn.Module):
             # identical across stages (see module docstring)
             x = tp_region(x, self.pipe_axis)
             mb = b // self.num_microbatches
-            h = pipeline_spmd(stage_fn,
-                              x.reshape(self.num_microbatches, mb, s, d),
-                              self.pipe_axis)
+            xmb = x.reshape(self.num_microbatches, mb, s, d)
+            if self.interleave == 2:
+                if layers % 2:
+                    raise ValueError(
+                        f"interleave=2 needs an even per-stage layer "
+                        f"count, got {layers}")
+                h = pipeline_spmd_interleaved(stage_fn_chunk, xmb,
+                                              self.pipe_axis)
+            else:
+                h = pipeline_spmd(stage_fn, xmb, self.pipe_axis)
             h = last_stage_broadcast(h.reshape(b, s, d), self.pipe_axis)
         h = _layernorm(h, ln_f_s, ln_f_b)
         logits = h @ head_k.astype(dtype) + head_b.astype(dtype)

@@ -75,6 +75,62 @@ def pipeline_spmd(stage_fn, x_microbatches, axis_name: str):
     return outputs
 
 
+def pipeline_spmd_interleaved(stage_fn, x_microbatches, axis_name: str):
+    """Two-virtual-stages-per-device (interleaved) GPipe schedule.
+
+    Device j runs virtual stages j and j+pp: a microbatch circles the
+    ring twice, using the device's first layer chunk on lap 0 and its
+    second on lap 1.  Each tick runs HALF a stage's layers, and the
+    schedule takes 2·pp·ceil(M/pp) + pp - 1 ticks (= 2M + pp - 1 when
+    pp | M) — so the fill/drain bubble costs (pp-1) half-ticks instead
+    of GPipe's (pp-1) full ticks: bubble time halves at equal M
+    (Megatron-LM interleaving, arXiv:2104.04473 §2.2, expressed in the
+    same scan+ppermute SPMD formulation as `pipeline_spmd`).
+
+    The static injection pattern alternates pp-tick blocks: device 0
+    injects microbatches m = b·pp + r at tick i = 2·pp·b + r, and the
+    lap-1 activation of that microbatch returns to device 0 exactly pp
+    ticks later, in the non-injection block.  Chunk selection at
+    (device j, tick t) is the parity of (t - j) // pp — fully static,
+    no data-dependent control flow.
+
+    stage_fn: (activation, chunk_index) -> activation, chunk_index in
+      {0, 1} selecting the device-local layer chunk.
+    Returns [M, microbatch, ...] outputs, valid on the LAST device
+    (which hosts the final virtual stage 2pp-1); zeros elsewhere.
+    """
+    pp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    perm = [(j, (j + 1) % pp) for j in range(pp)]
+    nblocks = -(-m // pp)
+    ticks = 2 * pp * nblocks + pp - 1
+
+    def tick(carry, t):
+        recv, outputs = carry
+        tj = t - idx                      # ticks since this activation
+        lap = jnp.where(tj >= 0, (tj // pp) % 2, 0)
+        inj = tj - lap * pp               # its injection tick at dev 0
+        mb_idx = inj - (inj // (2 * pp)) * pp
+        mb = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(mb_idx, 0, m - 1), keepdims=False)
+        inject = jnp.logical_and(idx == 0, lap == 0)
+        out = stage_fn(jnp.where(inject, mb, recv), lap)
+        w = jnp.clip(mb_idx, 0, m - 1)
+        valid = ((idx == pp - 1) & (lap == 1) & (tj >= 0)
+                 & (mb_idx >= 0) & (mb_idx < m))
+        cur = lax.dynamic_index_in_dim(outputs, w, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, out, cur), w, axis=0)
+        recv = lax.ppermute(out, axis_name, perm)
+        return (recv, outputs), None
+
+    carry0 = (jnp.zeros_like(x_microbatches[0]),
+              jnp.zeros_like(x_microbatches))
+    (_, outputs), _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    return outputs
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def last_stage_broadcast(x, axis_name: str):
     """Broadcast the last stage's value to every stage (mask + psum).

@@ -270,6 +270,9 @@ class Trainer:
                     for k in ("seq_axis", "model_axis", "expert_axis",
                               "pipe_axis")
                     if getattr(init_model, k, None) is not None}
+        if clone_kw and getattr(init_model, "interleave", 1) != 1:
+            # param shapes don't depend on the visitation order either
+            clone_kw["interleave"] = 1
         if clone_kw:
             init_model = init_model.clone(**clone_kw)
         variables = jax.jit(init_model.init, static_argnames=("train",))(

@@ -19,7 +19,8 @@ from dtf_tpu.cli import run
 from dtf_tpu.config import Config
 from dtf_tpu.models.pipeline_lm import (PipelinedTransformerLM,
                                         pipeline_param_partition_specs)
-from dtf_tpu.parallel.pipeline import last_stage_broadcast, pipeline_spmd
+from dtf_tpu.parallel.pipeline import (last_stage_broadcast, pipeline_spmd,
+                                       pipeline_spmd_interleaved)
 from dtf_tpu.runtime.mesh import MODEL_AXIS, make_mesh
 
 TINY_LM = dataclasses.replace(data_base.LM, num_classes=64, seq_len=16,
@@ -78,6 +79,45 @@ def test_pipeline_spmd_per_stage_transform(eight_devices):
                                10.0 * np.ones((4, 2, 3)), rtol=1e-6)
 
 
+def test_pipeline_interleaved_identity_stages(eight_devices):
+    """Interleaved schedule with identity chunks is a delayed copy —
+    including the M > pp multi-block injection pattern."""
+    mesh = make_mesh(eight_devices[:4], data=1, seq=1, model=4)
+    for m in (4, 8, 2):  # = pp, 2 blocks, partial block
+        x = jnp.asarray(np.random.default_rng(m).normal(size=(m, 2, 3)),
+                        jnp.float32)
+
+        def f(x_mb):
+            out = pipeline_spmd_interleaved(lambda h, c: h, x_mb,
+                                            MODEL_AXIS)
+            return last_stage_broadcast(out, MODEL_AXIS)
+
+        fn = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(),
+                                   out_specs=P(), check_vma=False))
+        np.testing.assert_allclose(np.asarray(fn(x)), np.asarray(x),
+                                   rtol=1e-6, err_msg=f"M={m}")
+
+
+def test_pipeline_interleaved_visitation_order(eight_devices):
+    """Each (device, lap) adds (idx+1)·10^lap: a microbatch must pass
+    lap-0 of every device then lap-1 of every device."""
+    mesh = make_mesh(eight_devices[:4], data=1, seq=1, model=4)
+    x = jnp.zeros((4, 2, 3), jnp.float32)
+
+    def f(x_mb):
+        def stage(h, lap):
+            return h + (jax.lax.axis_index(MODEL_AXIS) + 1.0) * \
+                jnp.where(lap == 0, 1.0, 10.0)
+        return last_stage_broadcast(
+            pipeline_spmd_interleaved(stage, x_mb, MODEL_AXIS),
+            MODEL_AXIS)
+
+    fn = jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                               check_vma=False))
+    np.testing.assert_allclose(np.asarray(fn(x)),
+                               110.0 * np.ones((4, 2, 3)), rtol=1e-6)
+
+
 def _sharded_pipe_call(mesh, variables, model, tokens, grad: bool = False):
     pspecs = {"params": pipeline_param_partition_specs(
         variables["params"], MODEL_AXIS)}
@@ -119,6 +159,59 @@ def test_pp_logits_match_unsharded(eight_devices):
     out = _sharded_pipe_call(mesh, variables, pp_model, tokens)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_pp_interleaved_logits_match_local_twin(eight_devices):
+    """interleave=2 visits layers chunk-interleaved, so the oracle is
+    the local twin with the same visitation order (interleave_pp)."""
+    mesh = make_mesh(eight_devices[:2], data=1, seq=1, model=2)
+    ref_model = tiny_pipe(interleave=2, interleave_pp=2)
+    pp_model = tiny_pipe(pipe_axis=MODEL_AXIS, interleave=2,
+                         num_microbatches=4)
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, 64, (8, 16)).astype(np.int32))
+    variables = {"params": ref_model.init(jax.random.key(0),
+                                          tokens)["params"]}
+    ref = ref_model.apply(variables, tokens)
+    out = _sharded_pipe_call(mesh, variables, pp_model, tokens)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_pp_interleaved_grads_match_local_twin(eight_devices):
+    mesh = make_mesh(eight_devices[:2], data=1, seq=1, model=2)
+    ref_model = tiny_pipe(interleave=2, interleave_pp=2)
+    pp_model = tiny_pipe(pipe_axis=MODEL_AXIS, interleave=2)
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, 64, (4, 16)).astype(np.int32))
+    variables = {"params": ref_model.init(jax.random.key(0),
+                                          tokens)["params"]}
+
+    def loss_fn(v):
+        logits = ref_model.apply(v, tokens)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0] * -1.0)
+
+    ref_loss, ref_grads = jax.value_and_grad(loss_fn)(variables)
+    pp_loss, pp_grads = _sharded_pipe_call(mesh, variables, pp_model,
+                                           tokens, grad=True)
+    np.testing.assert_allclose(float(ref_loss), float(pp_loss), rtol=1e-5)
+    for name in ("embed", "head_k", "qkv_k", "fc2_b"):
+        np.testing.assert_allclose(
+            np.asarray(ref_grads["params"][name]),
+            np.asarray(pp_grads["params"][name]),
+            atol=1e-5, rtol=1e-4, err_msg=name)
+
+
+def test_pp_interleaved_cli(tiny_pipe_registry):
+    """--pipeline_interleave 2 end-to-end through the runner."""
+    stats = run(base_cfg(model_parallelism=2, num_microbatches=2,
+                         pipeline_interleave=2))
+    assert np.isfinite(stats["loss"])
+
+
+def test_pp_interleave_requires_stages():
+    with pytest.raises(ValueError, match="model_parallelism"):
+        run(base_cfg(pipeline_interleave=2, num_microbatches=2))
 
 
 def test_pp_grads_match_unsharded(eight_devices):
