@@ -24,6 +24,14 @@
 //   INFO=4   :                              -> u8 st, u64 n, u64 version
 //   DONE=5   :                              -> u8 st   (worker finished)
 //   SHUTDOWN=6:                             -> u8 st   (server exits)
+//   PULL16=7 :                              -> u8 st, u64 n, u64 version, bf16[n]
+//   PUSH16=8 : f32 lr, u64 n, bf16[n] grads-> u8 st, u64 version
+//
+// The bf16 ops (--ps_wire bf16) halve wire traffic: params/grads cross
+// the network as round-to-nearest-even bfloat16 while the store's
+// master params and momentum stay f32 (wire compression only — the
+// update math is unchanged).  For ResNet-50 that is ~100 MB/step/worker
+// instead of ~200 MB.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -49,7 +57,28 @@ enum Op : uint8_t {
   OP_INFO = 4,
   OP_DONE = 5,
   OP_SHUTDOWN = 6,
+  OP_PULL16 = 7,
+  OP_PUSH16 = 8,
 };
+
+// f32 -> bf16 with round-to-nearest-even (the numpy/JAX convention).
+// NaNs are preserved explicitly (truncate + quiet bit): the RNE add
+// would carry a low-mantissa NaN payload into Inf, or wrap to zero.
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t u;
+  memcpy(&u, &f, 4);
+  if ((u & 0x7F800000u) == 0x7F800000u && (u & 0x007FFFFFu))
+    return static_cast<uint16_t>((u >> 16) | 0x0040u);
+  const uint32_t rounded = u + 0x7FFFu + ((u >> 16) & 1u);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+inline float bf16_to_f32(uint16_t h) {
+  const uint32_t u = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &u, 4);
+  return f;
+}
 
 // Parameters larger than this are a corrupt/hostile request, not a real
 // model (4B f32 = 16 GiB).
@@ -108,6 +137,7 @@ void PsServer::handle_conn(int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   std::vector<float> scratch;
+  std::vector<uint16_t> scratch16;
   for (;;) {
     uint8_t op;
     if (!read_full(fd, &op, 1)) break;
@@ -192,6 +222,62 @@ void PsServer::handle_conn(int fd) {
           const float m = momentum;
           for (uint64_t i = 0; i < n; ++i) {
             v[i] = m * v[i] - lr * g[i];
+            p[i] += v[i];
+          }
+          ver = ++version;
+        }
+      }
+      uint8_t resp[9];
+      resp[0] = st;
+      memcpy(resp + 1, &ver, 8);
+      if (!write_full(fd, resp, 9)) break;
+    } else if (op == OP_PULL16) {
+      std::unique_lock<std::mutex> lk(mu);
+      if (!initialized) {
+        lk.unlock();
+        uint8_t st = 2;
+        if (!write_full(fd, &st, 1)) break;
+        continue;
+      }
+      uint64_t ver = version, n = params.size();
+      try {
+        scratch16.resize(n);
+      } catch (const std::bad_alloc&) {
+        break;
+      }
+      for (uint64_t i = 0; i < n; ++i)
+        scratch16[i] = f32_to_bf16(params[i]);
+      lk.unlock();
+      uint8_t hdr[17];
+      hdr[0] = 0;
+      memcpy(hdr + 1, &n, 8);
+      memcpy(hdr + 9, &ver, 8);
+      if (!write_full(fd, hdr, 17)) break;
+      if (!write_full(fd, scratch16.data(), n * 2)) break;
+    } else if (op == OP_PUSH16) {
+      float lr;
+      uint64_t n;
+      if (!read_full(fd, &lr, 4) || !read_full(fd, &n, 8) ||
+          n == 0 || n > kMaxParams)
+        break;
+      try {
+        scratch16.resize(n);
+      } catch (const std::bad_alloc&) {
+        break;
+      }
+      if (!read_full(fd, scratch16.data(), n * 2)) break;
+      uint8_t st = 0;
+      uint64_t ver = 0;
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        if (!initialized || params.size() != n) {
+          st = 2;
+        } else {
+          float* p = params.data();
+          float* v = velocity.data();
+          const float m = momentum;
+          for (uint64_t i = 0; i < n; ++i) {
+            v[i] = m * v[i] - lr * bf16_to_f32(scratch16[i]);
             p[i] += v[i];
           }
           ver = ++version;

@@ -37,7 +37,28 @@ from dtf_tpu import native as native_lib
 
 log = logging.getLogger("dtf_tpu")
 
-OP_INIT, OP_PULL, OP_PUSH, OP_INFO, OP_DONE, OP_SHUTDOWN = 1, 2, 3, 4, 5, 6
+(OP_INIT, OP_PULL, OP_PUSH, OP_INFO, OP_DONE, OP_SHUTDOWN,
+ OP_PULL16, OP_PUSH16) = 1, 2, 3, 4, 5, 6, 7, 8
+
+
+def _f32_to_bf16_bytes(a: np.ndarray) -> bytes:
+    """Round-to-nearest-even f32 -> bf16, as raw u16 little-endian.
+    NaNs are preserved explicitly (truncate + force the quiet bit) —
+    the RNE add can carry a low-mantissa NaN payload into Inf or even
+    wrap to zero, silently masking a diverged gradient."""
+    u = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    r = ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1)))
+         >> np.uint32(16)).astype(np.uint16)
+    is_nan = ((u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)) \
+        & ((u & np.uint32(0x007FFFFF)) != 0)
+    nan_out = ((u >> np.uint32(16)).astype(np.uint16)
+               | np.uint16(0x0040))
+    return np.where(is_nan, nan_out, r).astype(np.uint16).tobytes()
+
+
+def _bf16_bytes_to_f32(b: bytes) -> np.ndarray:
+    u = np.frombuffer(b, np.uint16).astype(np.uint32) << np.uint32(16)
+    return u.view(np.float32)
 
 # Matches the C++ store's kMaxParams: a client-supplied count above this
 # is a corrupt/hostile request, not a real model (4B f32 = 16 GiB).
@@ -181,6 +202,29 @@ class _PyPsServer:
                         self.params += self.velocity
                         self.version += 1
                         conn.sendall(struct.pack("<BQ", 0, self.version))
+                elif op == OP_PULL16:
+                    with self.mu:
+                        if self.params is None:
+                            conn.sendall(b"\x02")
+                            continue
+                        snap = _f32_to_bf16_bytes(self.params)
+                        hdr = struct.pack("<BQQ", 0, self.params.size,
+                                          self.version)
+                    conn.sendall(hdr + snap)
+                elif op == OP_PUSH16:
+                    lr, n = struct.unpack("<fQ", _recvn(conn, 12))
+                    if n == 0 or n > MAX_PARAMS:
+                        return
+                    g = _bf16_bytes_to_f32(_recvn(conn, 2 * n))
+                    with self.mu:
+                        if self.params is None or self.params.size != n:
+                            conn.sendall(struct.pack("<BQ", 2, 0))
+                            continue
+                        self.velocity *= self.momentum
+                        self.velocity -= lr * g
+                        self.params += self.velocity
+                        self.version += 1
+                        conn.sendall(struct.pack("<BQ", 0, self.version))
                 elif op == OP_INFO:
                     with self.mu:
                         n = 0 if self.params is None else self.params.size
@@ -287,28 +331,41 @@ class PsClient:
             raise ValueError(f"ps init rejected: status={st} size={n}")
         return st, ver
 
-    def pull(self, retry_interval: float = 0.1,
-             timeout: float = 120.0) -> Tuple[int, np.ndarray]:
-        """Returns (version, flat params); blocks until initialized."""
+    def pull(self, retry_interval: float = 0.1, timeout: float = 120.0,
+             bf16: bool = False) -> Tuple[int, np.ndarray]:
+        """Returns (version, flat f32 params); blocks until initialized.
+        ``bf16`` pulls the bfloat16 wire encoding (half the traffic);
+        the returned array is expanded back to f32."""
         deadline = time.time() + timeout
         while True:
-            self.sock.sendall(bytes([OP_PULL]))
+            self.sock.sendall(bytes([OP_PULL16 if bf16 else OP_PULL]))
             (st,) = _recvn(self.sock, 1)
             if st == 0:
                 n, ver = struct.unpack("<QQ", _recvn(self.sock, 16))
-                flat = np.frombuffer(_recvn(self.sock, 4 * n), np.float32)
+                if bf16:
+                    flat = _bf16_bytes_to_f32(_recvn(self.sock, 2 * n))
+                else:
+                    flat = np.frombuffer(_recvn(self.sock, 4 * n),
+                                         np.float32)
                 return ver, flat
             if time.time() > deadline:
                 raise TimeoutError("parameter store never initialized")
             time.sleep(retry_interval)
 
-    def push(self, lr: float, grads: np.ndarray) -> int:
+    def push(self, lr: float, grads: np.ndarray, bf16: bool = False) -> int:
         """Apply one async Keras-SGD step on the store; returns the new
-        version."""
+        version.  ``bf16`` sends gradients as bfloat16 on the wire (the
+        store's update math stays f32)."""
         grads = np.ascontiguousarray(grads, np.float32)
-        self.sock.sendall(bytes([OP_PUSH]) +
-                          struct.pack("<fQ", float(lr), grads.size) +
-                          grads.tobytes())
+        if bf16:
+            payload = _f32_to_bf16_bytes(grads)
+            self.sock.sendall(bytes([OP_PUSH16]) +
+                              struct.pack("<fQ", float(lr), grads.size) +
+                              payload)
+        else:
+            self.sock.sendall(bytes([OP_PUSH]) +
+                              struct.pack("<fQ", float(lr), grads.size) +
+                              grads.tobytes())
         st, ver = struct.unpack("<BQ", _recvn(self.sock, 9))
         if st != 0:
             raise ValueError(f"ps push rejected: status={st}")
@@ -506,6 +563,7 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
         acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
         return loss, acc
 
+    wire_bf16 = cfg.ps_wire == "bf16"
     time_cb = TimeHistory(batch, cfg.log_steps)
     acc_key = ("categorical_accuracy" if spec.one_hot
                else "sparse_categorical_accuracy")
@@ -516,7 +574,7 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
         time_cb.on_epoch_begin(epoch)
         for _ in range(steps_per_epoch):
             time_cb.on_batch_begin(local_step)
-            version, flat = client.pull()
+            version, flat = client.pull(bf16=wire_bf16)
             images, labels = next(train_iter)
             gflat, loss, acc, batch_stats = step_fn(
                 jnp.asarray(flat), batch_stats, jnp.asarray(images),
@@ -525,7 +583,8 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
             # may have advanced `version` meanwhile (stale gradients are
             # inherent to async PS — same as the reference)
             lr = float(schedule(jnp.asarray(local_step)))
-            client.push(lr, np.asarray(jax.device_get(gflat)))
+            client.push(lr, np.asarray(jax.device_get(gflat)),
+                        bf16=wire_bf16)
             local_step += 1
             time_cb.on_batch_end(local_step)
         m_loss, m_acc = float(jax.device_get(loss)), float(jax.device_get(acc))

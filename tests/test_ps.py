@@ -61,6 +61,59 @@ def test_init_pull_push_roundtrip(server):
     client.close()
 
 
+def test_bf16_wire_roundtrip(server):
+    """--ps_wire bf16: pulls return bf16-rounded params, pushes apply
+    bf16-rounded grads with f32 store math — on both server builds."""
+    client = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    p0 = np.asarray([1.0, -2.5, 3.14159, 1e-3, 100.7], np.float32)
+    client.init(p0)
+    ver, flat = client.pull(bf16=True)
+    # pulled values are exactly the bf16 rounding of the stored f32
+    want = ps_lib._bf16_bytes_to_f32(ps_lib._f32_to_bf16_bytes(p0))
+    np.testing.assert_array_equal(flat, want)
+
+    g = np.asarray([0.5, 0.25, -0.125, 1.0, -1.0], np.float32)
+    ver = client.push(0.1, g, bf16=True)
+    assert ver == 1
+    _, flat1 = client.pull()  # f32 pull shows the f32 update math
+    gr = ps_lib._bf16_bytes_to_f32(ps_lib._f32_to_bf16_bytes(g))
+    np.testing.assert_allclose(flat1, p0 - 0.1 * gr, rtol=1e-6)
+    client.done()
+    client.close()
+
+
+def test_bf16_conversion_matches_numpy():
+    """The wire encoding is numpy/JAX's round-to-nearest-even bf16."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        rng.normal(0, 10, 1000).astype(np.float32),
+        np.asarray([0.0, -0.0, 1e-38, -1e38, np.inf, -np.inf],
+                   np.float32)])
+    ours = ps_lib._bf16_bytes_to_f32(ps_lib._f32_to_bf16_bytes(x))
+    jaxs = np.asarray(jnp.asarray(x).astype(jnp.bfloat16).astype(
+        jnp.float32))
+    np.testing.assert_array_equal(ours, jaxs)
+    # NaN payloads must stay NaN — including the low-mantissa sNaN that
+    # RNE would carry into Inf and the all-ones NaN that would wrap to 0
+    nans = np.asarray([0x7F800001, 0xFFFFFFFF, 0x7FC00000, 0xFFC00000],
+                      np.uint32).view(np.float32)
+    out = ps_lib._bf16_bytes_to_f32(ps_lib._f32_to_bf16_bytes(nans))
+    assert np.isnan(out).all()
+
+
+def test_async_e2e_bf16_wire():
+    """Single-process async demo trains with --ps_wire bf16."""
+    from dtf_tpu.config import Config
+    stats = ps_lib.run_async(Config(
+        model="trivial", dataset="cifar10", use_synthetic_data=True,
+        batch_size=8, train_steps=3, skip_eval=True, skip_checkpoint=True,
+        model_dir="", log_steps=1, distribution_strategy="parameter_server",
+        ps_mode="async", ps_wire="bf16", use_trivial_model=True,
+        num_classes=10))
+    assert np.isfinite(stats["loss"])
+
+
 def test_pull_before_init_blocks_then_succeeds(server):
     out = {}
 
