@@ -124,6 +124,33 @@ def run_bench(per_chip_batch: int, warmup: int = 5, iters: int = 20):
     return images_per_sec / n_chips, n_chips, step_ms, mfu
 
 
+def supplemental_benches():
+    """Input-pipeline and LM numbers folded into the headline line, so
+    one driver run captures the full perf story (still ONE JSON line —
+    the extra benches become fields, not lines).  Failures are reported
+    in-band, never allowed to take down the headline metric."""
+    extras = {}
+    try:
+        import bench_input
+        extras["input_pipeline"] = bench_input.measure()
+    except Exception as e:
+        extras["input_pipeline"] = {"error": str(e)[:200]}
+    try:
+        import bench_lm
+        r = bench_lm.train_bench(remat=False)
+        extras["lm"] = {
+            "metric": "lm_tokens_per_sec_per_chip",
+            "value": round(r["per_chip_tps"], 0),
+            "unit": "tokens/sec/chip",
+            "step_ms": round(r["step_ms"], 2),
+            "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
+            "seq_len": bench_lm.SEQ,
+        }
+    except Exception as e:
+        extras["lm"] = {"error": str(e)[:200]}
+    return extras
+
+
 def main():
     # 256 measured fastest per-chip on v5 lite (2,432 img/s vs 2,431
     # @384, 2,306 @512, 2,386 @128); fall back on OOM
@@ -142,7 +169,7 @@ def main():
                           "value": 0.0, "unit": "images/sec/chip",
                           "vs_baseline": 0.0, "error": str(err)[:200]}))
         sys.exit(1)
-    print(json.dumps({
+    out = {
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
@@ -152,7 +179,10 @@ def main():
         "per_chip_batch": batch,
         "n_chips": n_chips,
         "device_kind": jax.devices()[0].device_kind,
-    }))
+    }
+    if "--no-extras" not in sys.argv:
+        out.update(supplemental_benches())
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -61,12 +61,10 @@ def make_shards(root: str):
             os.path.join(root, f"train-{shard:05d}-of-01024"), recs)
 
 
-def main():
-    import sys
+def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
+    """Runs the pipeline measurement and returns the JSON-able dict
+    (shared by the CLI below and bench.py's combined report)."""
     from dtf_tpu.data.imagenet import imagenet_input_fn, native_jpeg_module
-
-    fast_dct = "--fast_dct" in sys.argv
-    scaled_decode = "--scaled_decode" in sys.argv
 
     stats: dict = {}
     with tempfile.TemporaryDirectory() as root:
@@ -75,10 +73,12 @@ def main():
         it = imagenet_input_fn(root, True, batch, seed=0, process_id=0,
                                process_count=1, fast_dct=fast_dct,
                                scaled_decode=scaled_decode, stats=stats)
-        # warmup: first batches pay thread spin-up + shuffle-buffer fill
+        # warmup: first batches pay thread spin-up + shuffle-buffer fill.
+        # Snapshot-and-subtract instead of clear(): workers update stats
+        # under their own lock, so mutating the dict from here races
         for _ in range(4):
             next(it)
-        stats.clear()
+        warm = dict(stats)
         t0 = time.perf_counter()
         seen = 0
         while seen < MEASURE_IMAGES:
@@ -91,12 +91,15 @@ def main():
     rate = seen / elapsed
     per_core = rate / cores
     serial_fraction = amdahl = None
-    if stats.get("batches"):
-        py_per_batch = stats["py_s"] / stats["batches"]
-        native_per_batch = stats["native_s"] / stats["batches"]
+    batches = stats.get("batches", 0) - warm.get("batches", 0)
+    if batches > 0:
+        py_per_batch = (stats.get("py_s", 0.0)
+                        - warm.get("py_s", 0.0)) / batches
+        native_per_batch = (stats.get("native_s", 0.0)
+                            - warm.get("native_s", 0.0)) / batches
         serial_fraction = py_per_batch / (py_per_batch + native_per_batch)
         amdahl = batch / py_per_batch
-    print(json.dumps({
+    return {
         "metric": "imagenet_input_pipeline_images_per_sec_per_host",
         "value": round(rate, 1),
         "unit": "images/sec/host",
@@ -111,7 +114,13 @@ def main():
             round(amdahl, 0) if amdahl is not None else None),
         "chip_demand": CHIP_DEMAND,
         "cores_needed_per_chip": round(CHIP_DEMAND / per_core, 1),
-    }))
+    }
+
+
+def main():
+    import sys
+    print(json.dumps(measure(fast_dct="--fast_dct" in sys.argv,
+                             scaled_decode="--scaled_decode" in sys.argv)))
 
 
 if __name__ == "__main__":

@@ -28,14 +28,25 @@ import sys
 
 # The gpipe variant measures a relative pipeline schedule, which needs
 # >=2 devices — force the 8-virtual-device CPU mesh before jax import.
-if "--variant" in sys.argv and "gpipe" in sys.argv:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--variant" in sys.argv and any(
+        v in sys.argv for v in ("gpipe", "gpipe_mem")):
+    os.environ["JAX_PLATFORMS"] = "cpu"  # override any TPU plugin env
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8")
 
 import time
 
 import jax
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var alone — it must
+# be re-applied through the config before backend init (same dance as
+# __graft_entry__.py).
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -171,46 +182,60 @@ def flash_bench(seq: int = 8192, warmup: int = 3, iters: int = 10):
                 seq=seq, shape=list(shape))
 
 
-def gpipe_bench(pp: int = 4, warmup: int = 2, iters: int = 5):
-    """Relative schedule measurement on the virtual CPU mesh: step time
-    at M = pp (worst bubble) vs the auto-scaled M = 4·pp.  Absolute CPU
-    times are meaningless; the ratio is the bubble-reduction claim."""
+def _gpipe_trainer(pp: int, m: int, interleave: int, remat: bool,
+                   mesh, batch: int, seq: int, vocab: int):
     import functools
 
     from dtf_tpu.config import Config
     from dtf_tpu.data.base import DatasetSpec
     from dtf_tpu.models.pipeline_lm import (PipelinedTransformerLM,
                                             pipeline_param_partition_specs)
-    from dtf_tpu.runtime.mesh import MESH_AXES, MODEL_AXIS, MeshRuntime
+    from dtf_tpu.runtime.mesh import MODEL_AXIS, MeshRuntime
     from dtf_tpu.train import Trainer
-    from jax.sharding import Mesh
 
+    spec = DatasetSpec("lm", 0, 0, vocab, 1024, 128, one_hot=False,
+                       seq_len=seq)
+    rt = MeshRuntime(mesh=mesh, strategy="mirrored", shard_seq=True)
+    cfg = Config(model="pipeline_transformer", dataset="lm",
+                 batch_size=batch, train_steps=1, skip_eval=True,
+                 optimizer="adamw")
+    model = PipelinedTransformerLM(
+        vocab_size=vocab, num_layers=2 * pp, d_model=64, num_heads=4,
+        d_ff=256, max_seq_len=seq, num_microbatches=m,
+        pipe_axis=MODEL_AXIS, interleave=interleave, remat=remat)
+    trainer = Trainer(cfg, rt, model, 0.0, spec,
+                      param_spec_fn=functools.partial(
+                          pipeline_param_partition_specs,
+                          pipe_axis=MODEL_AXIS))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+    labels = np.roll(tokens, -1, axis=1)
+    state = trainer.init_state(jax.random.key(0), (tokens, labels))
+    sharded = rt.shard_batch((tokens, labels))
+    return trainer, state, sharded
+
+
+def _gpipe_mesh(pp: int):
+    from dtf_tpu.runtime.mesh import MESH_AXES
+    from jax.sharding import Mesh
     devices = jax.devices()
     assert len(devices) >= pp, f"need {pp} devices, have {len(devices)}"
     dp = len(devices) // pp
     mesh = Mesh(np.array(devices[:dp * pp]).reshape(dp, 1, pp), MESH_AXES)
-    seq, vocab, batch = 128, 512, dp * 16
-    spec = DatasetSpec("lm", 0, 0, vocab, 1024, 128, one_hot=False,
-                       seq_len=seq)
+    return mesh, dp
 
-    def step_time(m):
-        rt = MeshRuntime(mesh=mesh, strategy="mirrored", shard_seq=True)
-        cfg = Config(model="pipeline_transformer", dataset="lm",
-                     batch_size=batch, train_steps=1, skip_eval=True,
-                     optimizer="adamw")
-        model = PipelinedTransformerLM(
-            vocab_size=vocab, num_layers=2 * pp, d_model=64, num_heads=4,
-            d_ff=256, max_seq_len=seq, num_microbatches=m,
-            pipe_axis=MODEL_AXIS)
-        trainer = Trainer(cfg, rt, model, 0.0, spec,
-                          param_spec_fn=functools.partial(
-                              pipeline_param_partition_specs,
-                              pipe_axis=MODEL_AXIS))
-        rng = np.random.default_rng(0)
-        tokens = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
-        labels = np.roll(tokens, -1, axis=1)
-        state = trainer.init_state(jax.random.key(0), (tokens, labels))
-        sharded = rt.shard_batch((tokens, labels))
+
+def gpipe_bench(pp: int = 4, warmup: int = 2, iters: int = 5):
+    """Relative schedule measurement on the virtual CPU mesh: step time
+    at M = pp (worst bubble) vs the auto-scaled M = 4·pp, plus the
+    interleaved (two-virtual-stages-per-device) schedule at both M.
+    Absolute CPU times are meaningless; the ratios are the claims."""
+    mesh, dp = _gpipe_mesh(pp)
+    seq, vocab, batch = 128, 512, dp * 16
+
+    def step_time(m, interleave=1):
+        trainer, state, sharded = _gpipe_trainer(
+            pp, m, interleave, False, mesh, batch, seq, vocab)
         for _ in range(warmup):
             state, metrics = trainer.train_step(state, *sharded)
         _sync(metrics["loss"])
@@ -222,10 +247,45 @@ def gpipe_bench(pp: int = 4, warmup: int = 2, iters: int = 5):
 
     worst = step_time(pp)        # bubble (pp-1)/(2pp-1) = 3/7 at pp=4
     best = step_time(4 * pp)     # bubble (pp-1)/(5pp-1) = 3/19 at pp=4
+    il_low = step_time(pp, interleave=2)    # (pp-1)/(3pp-1) in half-ticks
+    il_high = step_time(4 * pp, interleave=2)
     return dict(pp=pp, m_low=pp, m_high=4 * pp,
                 step_ms_m_low=round(worst, 1),
                 step_ms_m_high=round(best, 1),
-                speedup=worst / best)
+                step_ms_m_low_interleaved=round(il_low, 1),
+                step_ms_m_high_interleaved=round(il_high, 1),
+                speedup=worst / best,
+                interleave_speedup_at_m_low=worst / il_low,
+                interleave_speedup_at_m_high=best / il_high)
+
+
+def gpipe_mem(pp: int = 4):
+    """Peak-memory table: XLA's own buffer assignment (temp + args +
+    output) for the compiled train step, M x remat x interleave.  The
+    GPipe memory story the docs quote comes from this."""
+    mesh, dp = _gpipe_mesh(pp)
+    seq, vocab, batch = 128, 512, dp * 16
+    rows = []
+    for m in (pp, 2 * pp, 4 * pp):
+        for remat in (False, True):
+            for il in (1, 2):
+                trainer, state, sharded = _gpipe_trainer(
+                    pp, m, il, remat, mesh, batch, seq, vocab)
+                row = dict(m=m, remat=remat, interleave=il)
+                try:
+                    compiled = trainer.train_step.lower(
+                        state, *sharded).compile()
+                    ma = compiled.memory_analysis()
+                    ma = ma[0] if isinstance(ma, (list, tuple)) else ma
+                    row["temp_mb"] = round(
+                        ma.temp_size_in_bytes / 2**20, 1)
+                    row["total_mb"] = round(
+                        (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                         + ma.output_size_in_bytes) / 2**20, 1)
+                except Exception as e:  # backend without memory stats
+                    row["error"] = str(e)[:80]
+                rows.append(row)
+    return dict(pp=pp, batch=batch, seq=seq, rows=rows)
 
 
 def main():
@@ -256,6 +316,21 @@ def main():
             "pp": r["pp"], "m_low": r["m_low"], "m_high": r["m_high"],
             "step_ms_m_low": r["step_ms_m_low"],
             "step_ms_m_high": r["step_ms_m_high"],
+            "step_ms_m_low_interleaved": r["step_ms_m_low_interleaved"],
+            "step_ms_m_high_interleaved": r["step_ms_m_high_interleaved"],
+            "interleave_speedup_at_m_low": round(
+                r["interleave_speedup_at_m_low"], 2),
+            "interleave_speedup_at_m_high": round(
+                r["interleave_speedup_at_m_high"], 2),
+            "backend": jax.default_backend(),
+        }))
+        return
+    if variant == "gpipe_mem":
+        r = gpipe_mem()
+        print(json.dumps({
+            "metric": "gpipe_memory_table",
+            "value": len(r["rows"]), "unit": "configs",
+            "vs_baseline": None, **r,
             "backend": jax.default_backend(),
         }))
         return

