@@ -41,57 +41,58 @@ def hbm_gbps(device) -> float | None:
     return None
 
 
-_SHAPE = re.compile(r"(bf16|f32|s32|pred|u8)\[([0-9,]*)\]")
-
-
-def _shapes(hlo_line: str):
-    return [(m.group(1), [int(x) for x in m.group(2).split(",") if x])
-            for m in _SHAPE.finditer(hlo_line)]
+_DEF = re.compile(r"^\s*(?:ROOT\s+)?%(\S+?)\s*=\s*"
+                  r"(bf16|f32|s32|pred|u8)\[([0-9,]*)\]")
+_CONV = re.compile(r"convolution\(%(\S+?),\s*%(\S+?)\)")
+_OPNAME = re.compile(r'op_name="[^"]*?/([^/"]+/[^/"]+)"')
 
 
 def conv_table(hlo_text: str):
     """Per-convolution flops + minimal bytes from the optimized HLO.
-    Operand order in HLO convolution is (activations, kernel); dim
-    semantics come from the printed dnums, but for flop counting only
-    the products matter: flops = 2 * prod(output) * prod(kernel_spatial
-    * in_channels) / out_channels_in_kernel."""
+    Operands are %fusion references, so shapes come from a first-pass
+    symbol table.  flops = 2 * prod(output) * kernel_elems /
+    out_channels (the kernel dim shared with the output)."""
+    shapes: dict = {}
+    for line in hlo_text.splitlines():
+        m = _DEF.match(line)
+        if m:
+            shapes[m.group(1)] = (
+                m.group(2),
+                [int(x) for x in m.group(3).split(",") if x])
     rows = []
     for line in hlo_text.splitlines():
-        if "convolution(" not in line and " convolution " not in line:
+        if "convolution(" not in line:
             continue
-        shapes = _shapes(line)
-        if len(shapes) < 3:
+        md = _DEF.match(line)
+        mc = _CONV.search(line)
+        if not md or not mc:
             continue
-        out_dt, out = shapes[0], None
-        # first shape on the line is the result; last two before args
-        # close are the operands
-        result = shapes[0]
-        operands = shapes[1:3]
-        out = result[1]
-        # kernel operand: the one whose total size is smallest is
-        # usually the filter for these models
-        a, b = operands
-        kernel = min((a, b), key=lambda s: int(np.prod(s[1])) if s[1] else 0)
-        act = a if kernel is b else b
-        if not out or not kernel[1]:
+        out_dt = md.group(2)
+        out = [int(x) for x in md.group(3).split(",") if x]
+        ops = [shapes.get(mc.group(1)), shapes.get(mc.group(2))]
+        if not out or any(o is None or not o[1] for o in ops):
             continue
+        kernel = min(ops, key=lambda s: int(np.prod(s[1])))
+        act = ops[0] if kernel is ops[1] else ops[1]
         k_elems = int(np.prod(kernel[1]))
         out_elems = int(np.prod(out))
-        # flops = 2 * out_elems * (kernel_elems / out_channels); out
-        # channels is the kernel dim matching a dim of out
-        out_ch = None
-        for d in sorted(kernel[1], reverse=True):
-            if d in out:
-                out_ch = d
-                break
+        # out channels: HWIO kernels put O last and NHWC outputs put C
+        # last — prefer that match (the largest-dim heuristic alone can
+        # grab the batch dim, e.g. in_channels 256 vs batch 256)
+        if kernel[1][-1] == out[-1]:
+            out_ch = kernel[1][-1]
+        else:
+            out_ch = next((d for d in sorted(kernel[1], reverse=True)
+                           if d in out), None)
         if not out_ch:
             continue
         flops = 2.0 * out_elems * (k_elems / out_ch)
-        bpe = 2 if result[0] == "bf16" else 4
-        bytes_min = bpe * (out_elems + k_elems +
-                           (int(np.prod(act[1])) if act[1] else 0))
+        bpe = 2 if out_dt == "bf16" else 4
+        bytes_min = bpe * (out_elems + k_elems + int(np.prod(act[1])))
+        name = _OPNAME.search(line)
         rows.append(dict(out=out, kernel=kernel[1], flops=flops,
-                         bytes_min=bytes_min))
+                         bytes_min=bytes_min,
+                         name=name.group(1) if name else ""))
     return rows
 
 
@@ -132,13 +133,23 @@ def main():
         jax.device_get(jax.tree_util.tree_leaves(out)[0])
         return (time.perf_counter() - t0) / iters
 
-    # full step
-    step_s = timed(lambda s, a, b: trainer.train_step(s, a, b),
-                   state, *sharded)
+    # full step — the state argument is donated, so thread it through
+    def run_steps(n):
+        nonlocal state
+        for _ in range(n):
+            state, metrics = trainer.train_step(state, *sharded)
+        return metrics
+
+    m = run_steps(5)
+    jax.device_get(m["loss"])
+    t0 = time.perf_counter()
+    m = run_steps(20)
+    jax.device_get(m["loss"])
+    step_s = (time.perf_counter() - t0) / 20
 
     # fwd-only (loss value, no grad)
     def fwd_only(params, bstats, images, labels):
-        logits, _ = trainer._apply(params, bstats, images, True)
+        logits, _, _ = trainer._apply(params, bstats, images, True)
         return jnp.mean(logits.astype(jnp.float32))
 
     fwd_jit = jax.jit(fwd_only)
@@ -158,7 +169,8 @@ def main():
     convs.sort(key=lambda c: -c["t_floor_us"])
     floor_sum_ms = sum(c["t_floor_us"] for c in convs) / 1e3
 
-    top = [{"out": "x".join(map(str, c["out"])),
+    top = [{"name": c.get("name", ""),
+            "out": "x".join(map(str, c["out"])),
             "kernel": "x".join(map(str, c["kernel"])),
             "gflops": round(c["flops"] / 1e9, 1),
             "t_floor_us": round(c["t_floor_us"], 1),
@@ -175,7 +187,11 @@ def main():
         "fwd_ms": round(fwd_s * 1e3, 2),
         "bwd_update_ms": round((step_s - fwd_s) * 1e3, 2),
         "xla_flops_g": round(flops / 1e9, 1),
-        "xla_bytes_gb": round(bytes_acc / 2**30, 2),
+        "xla_bytes_gb": round(bytes_acc / 1e9, 2),  # decimal GB, matches GB/s
+        "hbm_floor_ms": (round(bytes_acc / (gbps * 1e9) * 1e3, 2)
+                         if gbps else None),
+        "compute_floor_ms": (round(flops / (peak * 1e12) * 1e3, 2)
+                             if peak else None),
         "achieved_tflops": round(flops / step_s / 1e12, 1),
         "achieved_hbm_gbps": round(bytes_acc / step_s / 1e9, 1),
         "peak_tflops": peak, "peak_hbm_gbps": gbps,
