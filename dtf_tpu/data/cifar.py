@@ -47,6 +47,22 @@ def get_filenames(is_training: bool, data_dir: str):
     return [os.path.join(data_dir, "test_batch.bin")]
 
 
+def write_binary_file(path: str, images: np.ndarray,
+                      labels: np.ndarray) -> None:
+    """Write records in the CIFAR binary wire format: 1 label byte +
+    3072 CHW image bytes each (cifar_preprocessing.py:30-33).  The
+    inverse of :func:`load_records`; used by tests and run_record.py to
+    synthesize datasets the production reader consumes."""
+    images = np.asarray(images, np.uint8)
+    labels = np.asarray(labels)
+    n = len(labels)
+    recs = np.zeros((n, RECORD_BYTES), np.uint8)
+    recs[:, 0] = labels
+    recs[:, 1:] = images.transpose(0, 3, 1, 2).reshape(n, -1)
+    with open(path, "wb") as f:
+        f.write(recs.tobytes())
+
+
 def load_records(filenames) -> Tuple[np.ndarray, np.ndarray]:
     """Parses fixed-length records → (images HWC float32, labels int32).
     CHW→HWC transpose per reference parse_record (:43-75)."""

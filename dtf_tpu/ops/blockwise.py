@@ -53,8 +53,12 @@ def block_accumulate(o, m, l, q, k, v, scale: float, bias=None):
     p = jnp.exp(s - m_safe[..., None])
     corr = jnp.exp(m - m_safe)
     l_new = l * corr + jnp.sum(p, axis=-1)
+    # P·V runs at the operands' native precision with f32 accumulation:
+    # when v is bf16 (the TPU kernel path), p is cast DOWN so the MXU
+    # sees bf16×bf16 (full rate) — the standard flash-attention trade.
+    # f32 callers (oracle, ring attention) are bit-for-bit unchanged.
     o_new = o * corr[..., None] + jnp.einsum(
-        "...qk,...kd->...qd", p, v.astype(p.dtype),
+        "...qk,...kd->...qd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32)
     return o_new, m_new, l_new
 

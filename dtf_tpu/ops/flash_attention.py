@@ -15,8 +15,14 @@ output tile, no atomics), recomputing probabilities per tile from the
 saved log-sum-exp (the standard flash trade: extra FLOPs for O(S²)
 less HBM traffic).  `_blockwise_bwd` (plain JAX, same math) remains as
 the portable oracle the kernels are tested against.  Measured on one
-TPU v5 lite chip, [2, 8192, 8, 128] bf16 causal: fwd 13.5 ms,
-backward 9.5 ms — 0.70× the forward.
+TPU v5 lite chip, [2, 8192, 8, 128] bf16 causal: fwd 10.2 ms,
+backward-only 7.2 ms — 0.70× the forward (bench_lm.py --variant
+flash).  All three kernels stream K/V (or Q/dO) through VMEM one block
+per sequential grid step — carries live in VMEM scratch (fwd) or
+revisited output tiles (dq, dk/dv) — so VMEM stays capped at the block
+size regardless of sequence length: seq 32k compiles and runs (fwd
+33 ms at [1, 32768, 4, 128]) where a resident-K/V formulation exceeds
+scoped VMEM from seq 8k.
 
 On non-TPU backends `flash_attention` transparently falls back to the
 differentiable `ops.blockwise.blockwise_attention` (same math), so the
@@ -28,87 +34,125 @@ interpreter on CPU (used by tests to validate the kernel itself).
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from dtf_tpu.ops import blockwise as bw
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# 1024 measured fastest for the streaming kernel on v5e (block sweep
+# at seq 8k: 1024² ≈ 10.5 ms vs 512² ≈ 16 ms — fewer grid steps, same
+# capped VMEM; 2048-blocks exceed scoped VMEM and fail to compile)
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 
 
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k):
-    """One program: one [block_q, D] query tile vs all of K/V."""
-    block_q, head_dim = q_ref.shape
-    seq_k = k_ref.shape[0]
-    num_kv = seq_k // block_k
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, oacc_ref, m_ref,
+                l_ref, *, scale, causal):
+    """Grid (BH, Sq/block_q, Sk/block_k): one K/V block per step.
+
+    K/V stream through VMEM one [block_k, D] tile at a time (the r2
+    kernel held the FULL [Sk, D] K and V per program, which sat at the
+    ~16 MB scoped-VMEM edge from seq 8k and failed outright beyond).
+    The online-softmax carry (un-normalized o in f32, running max m,
+    denominator l) lives in VMEM scratch that persists across the
+    sequential k grid dimension — never touching HBM.  The final
+    (o, lse) are written on the last live k step.
+
+    Inputs stay in their native dtype (bf16 in production): the MXU
+    multiplies bf16×bf16 with f32 accumulation at full rate, and for
+    bf16 inputs the products are exact in f32 — upcasting first only
+    slowed the matmuls (measured ~20 vs ~70 TFLOP/s on v5e).
+    """
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
+    num_kv = pl.num_programs(2)
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
 
-    q = q_ref[...].astype(jnp.float32)
-    o = jnp.zeros((block_q, head_dim), jnp.float32)
-    m = jnp.full((block_q,), bw.NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    @pl.when(jk == 0)
+    def _init():
+        oacc_ref[...] = jnp.zeros_like(oacc_ref)
+        m_ref[...] = jnp.full_like(m_ref, bw.NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        o, m, l = carry
-        k = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    live = (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
         bias = None
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             bias = jnp.where(q_pos >= k_pos, 0.0, bw.NEG_INF)
-        return bw.block_accumulate(o, m, l, q, k, v, scale, bias)
+        o, m, l = bw.block_accumulate(
+            oacc_ref[...], m_ref[...][:, 0], l_ref[...][:, 0],
+            q, k, v, scale, bias)
+        oacc_ref[...] = o
+        m_ref[...] = m[:, None]
+        l_ref[...] = l[:, None]
 
     if causal:
-        # only blocks that intersect the causal triangle contribute
-        num_kv_live = jax.lax.div(
-            (iq + 1) * block_q + block_k - 1, block_k)
-        num_kv_live = jnp.minimum(num_kv_live, num_kv)
+        j_last = jnp.minimum(
+            num_kv - 1, jax.lax.div((iq + 1) * block_q - 1, block_k))
     else:
-        num_kv_live = num_kv
-    o, m, l = jax.lax.fori_loop(0, num_kv_live, body, (o, m, l))
+        j_last = num_kv - 1
 
-    o_ref[...] = bw.finalize(o, l).astype(o_ref.dtype)
-    lse = (jnp.maximum(m, bw.NEG_INF)
-           + jnp.log(jnp.where(l == 0.0, 1.0, l)))
-    lse_ref[...] = lse[:, None]  # [block_q, 1]; see out_specs tiling note
+    @pl.when(jk == j_last)
+    def _finalize():
+        o = oacc_ref[...]
+        m = m_ref[...][:, 0]
+        l = l_ref[...][:, 0]
+        o_ref[...] = bw.finalize(o, l).astype(o_ref.dtype)
+        lse = (jnp.maximum(m, bw.NEG_INF)
+               + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+        lse_ref[...] = lse[:, None]  # [block_q, 1]; see out_specs note
 
 
 def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
     """q, k, v: [BH, S, D] → (o [BH, Sq, D], lse [BH, Sq])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    grid = (bh, sq // block_q)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k)
+    grid = (bh, sq // block_q, sk // block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
             # lse kept 3-D [BH, Sq, 1]: TPU lowering requires the last
             # two block dims to tile (8, 128) or equal the array dims;
             # (block_q, 1) satisfies that where a 1-D (block_q,) cannot.
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        # f32 online-softmax carry, on-chip only: persists across the
+        # sequential k grid dimension, re-initialized at jk == 0
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -133,42 +177,45 @@ def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_k):
-    block_q, head_dim = q_ref.shape
-    seq_k = k_ref.shape[0]
-    num_kv = seq_k // block_k
+               scale, causal):
+    """Grid (BH, Sq/block_q, Sk/block_k): K/V stream one block per step
+    (same capped-VMEM pattern as the forward); the dq tile accumulates
+    in its revisited output ref across the sequential k dimension."""
+    block_q = q_ref.shape[0]
+    block_k = k_ref.shape[0]
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
 
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][:, 0]
-    delta = delta_ref[...][:, 0]
-    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    @pl.when(jk == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
 
-    def body(j, dq):
-        k = k_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+    live = (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _tile():
+        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...][:, 0]
+        delta = delta_ref[...][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, bw.NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-
-    if causal:
-        num_kv_live = jax.lax.div((iq + 1) * block_q + block_k - 1, block_k)
-        num_kv_live = jnp.minimum(num_kv_live, num_kv)
-    else:
-        num_kv_live = num_kv
-    dq = jax.lax.fori_loop(0, num_kv_live, body,
-                           jnp.zeros((block_q, head_dim), jnp.float32))
-    dq_ref[...] = dq.astype(dq_ref.dtype)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        dq_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
@@ -192,10 +239,11 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
     @pl.when(live)
     def _tile():
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        q = q_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
+        k = k_ref[...]
+        v = v_ref[...]
+        q = q_ref[...]
+        do = do_ref[...]
         lse = lse_ref[...][:, 0]
         delta = delta_ref[...][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -208,11 +256,11 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             s = jnp.where(q_pos >= k_pos, s, bw.NEG_INF)
         p = jnp.exp(s - lse[:, None])                     # [bq, bk]
         dv_ref[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -228,18 +276,18 @@ def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     lse3 = lse[..., None]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
-        grid=(bh, sq // block_q),
+        functools.partial(_dq_kernel, scale=scale, causal=causal),
+        grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
         interpret=interpret,
     )(q, k, v, do, lse3, delta)
@@ -337,10 +385,14 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     use_pallas=None):
     """Multi-head attention, flash-style.  q, k, v: [B, S, H, D].
+
+    ``block_q``/``block_k``: None = auto (the measured-fastest default,
+    shrunk via gcd to divide the sequence — any seq length that worked
+    before keeps working); explicit values must divide the sequence.
 
     ``use_pallas``: None = auto (Pallas on TPU, blockwise-JAX
     elsewhere); True/False = force; "interpret" = Pallas interpreter
@@ -351,17 +403,21 @@ def flash_attention(q, k, v, *, causal: bool = False,
     scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if not use_pallas:
         return bw.blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                      block_k=block_k)
+                                      block_k=block_k or DEFAULT_BLOCK_K)
 
     interpret = use_pallas == "interpret"
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if block_q is None:
+        block_q = math.gcd(DEFAULT_BLOCK_Q, sq)
+    if block_k is None:
+        block_k = math.gcd(DEFAULT_BLOCK_K, sk)
     block_q = max(min(block_q, sq), 1)
     block_k = max(min(block_k, sk), 1)
     if sq % block_q or sk % block_k:
         raise ValueError(
-            f"seq lengths ({sq}, {sk}) must divide block sizes "
-            f"({block_q}, {block_k})")
+            f"block sizes ({block_q}, {block_k}) must divide the seq "
+            f"lengths ({sq}, {sk})")
 
     def merge(x):  # [B, S, H, D] → [B·H, S, D]
         return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)

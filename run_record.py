@@ -73,11 +73,7 @@ def write_cifar_binaries(root: str, num_train: int, num_eval: int):
         labels = rng.integers(0, 10, n)
         imgs = patterns[labels] + rng.normal(0, 30, (n, 32, 32, 3))
         imgs = np.clip(imgs, 0, 255).astype(np.uint8)
-        recs = np.zeros((n, cifar_mod.RECORD_BYTES), np.uint8)
-        recs[:, 0] = labels
-        recs[:, 1:] = imgs.transpose(0, 3, 1, 2).reshape(n, -1)
-        with open(os.path.join(d, name), "wb") as f:
-            f.write(recs.tobytes())
+        cifar_mod.write_binary_file(os.path.join(d, name), imgs, labels)
 
     rng = np.random.default_rng(42)
     per_file = num_train // 5
@@ -262,7 +258,9 @@ def main():
     ok = report["cifar"]["milestone_met"]
     print(f"\nmilestone eval top-1 >= {MILESTONE_TOP1}: "
           f"{'MET' if ok else 'NOT MET'}")
-    sys.exit(0 if ok else 1)
+    # --quick is a plumbing smoke pass (a 3-epoch budget cannot reach
+    # the milestone); only full runs gate their exit code on it
+    sys.exit(0 if (ok or quick) else 1)
 
 
 if __name__ == "__main__":

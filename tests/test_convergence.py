@@ -39,10 +39,7 @@ def cifar_real_dir(tmp_path_factory):
         labels = rng.integers(0, NUM_CLASSES, n)
         imgs = patterns[labels] + rng.normal(0, 24, (n, 32, 32, 3))
         imgs = np.clip(imgs, 0, 255).astype(np.uint8)
-        recs = np.zeros((n, cifar.RECORD_BYTES), np.uint8)
-        recs[:, 0] = labels
-        recs[:, 1:] = imgs.transpose(0, 3, 1, 2).reshape(n, -1)
-        (d / name).write_bytes(recs.tobytes())
+        cifar.write_binary_file(str(d / name), imgs, labels)
 
     for i in range(1, 6):
         write(f"data_batch_{i}.bin", per_file, rng)
