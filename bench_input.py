@@ -6,9 +6,12 @@ JPEG shards, with no device in the loop.  Prints ONE JSON line:
 
   value            images/sec sustained by this host
   per_core         value / cpu cores (the portable number)
-  serial_fraction  GIL-held Python share of each batch (parse + crop
-                   sampling) — this work serializes across worker
-                   threads, so it bounds multi-core scaling
+  serial_fraction  GIL-held Python share of each batch in the workers.
+                   With the fused dtf_train_example_batch op (r3) the
+                   parse + crop sampling run in C++ and this measures
+                   ~0; the remaining Python is the reader thread's
+                   record streaming (native TFRecord reader, cheap
+                   per-record yields), not the workers
   amdahl_ceiling_images_per_sec_per_host
                    batch_size / py_s_per_batch — the host rate at which
                    the serial Python share alone saturates one core,

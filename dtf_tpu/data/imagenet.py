@@ -343,16 +343,69 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                                DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE)
         return out - CHANNEL_MEANS
 
+    # Fully-native batch path: parse + crop-sample + decode all happen
+    # in ONE C++ call (dtf_train_example_batch) — the per-record Python
+    # work that used to run here (Example parse, header reads, numpy
+    # sampling) was the pipeline's measured GIL-held serial fraction.
+    # Gate on the LIBRARY symbol, not the Python wrapper (which always
+    # exists): a stale .so must fall back to the two-step native path
+    # it still supports, not crash the first batch.
+    def _lib_has_train_batch():
+        from dtf_tpu import native as native_lib
+        lib = native_lib.load()
+        return lib is not None and hasattr(lib, "dtf_train_example_batch")
+
+    full_native = batch_native and _lib_has_train_batch()
+
+    def _python_record(raw, wrng):
+        """Whole-record Python fallback (parse failures)."""
+        buf, label, bbox = parse_example_record(raw)
+        return preprocess_train(buf, bbox, wrng), label
+
     def batch_worker(wid: int):
-        """Parse + crop-sample + fused-decode one whole batch."""
+        """One whole batch per iteration, end-to-end in C++ when the
+        library provides the fused op; Python parse + fused decode
+        otherwise."""
         import time as _time
         wrng = np.random.default_rng(seed + 104729 * (process_id + 1) + wid)
+
+        def record_stats(py_s, native_s):
+            if stats is not None:
+                # dict read-modify-write is NOT atomic across threads
+                with stats_lock:
+                    stats["py_s"] = stats.get("py_s", 0.0) + py_s
+                    stats["native_s"] = (stats.get("native_s", 0.0)
+                                         + native_s)
+                    stats["batches"] = stats.get("batches", 0) + 1
+
         while True:
             chunk = raw_q.get()
             if chunk is None or stop.is_set():
                 out_q.put(None)
                 return
             try:
+                if full_native:
+                    t0 = _time.perf_counter()
+                    batch_seed = int(wrng.integers(0, 2**63))
+                    t1 = _time.perf_counter()
+                    images, labels, crops, flips, statuses = \
+                        nj.train_example_batch(
+                            chunk, batch_seed, DEFAULT_IMAGE_SIZE,
+                            DEFAULT_IMAGE_SIZE, CHANNEL_MEANS,
+                            num_threads=1, fast_dct=fast_dct,
+                            scaled_decode=scaled_decode)
+                    t2 = _time.perf_counter()
+                    for j in np.nonzero(statuses)[0]:
+                        if statuses[j] == 1:  # parse/header failure
+                            images[j], labels[j] = _python_record(
+                                chunk[j], wrng)
+                        else:  # decode failure: same crop/flip
+                            buf, _, _ = parse_example_record(chunk[j])
+                            images[j] = _slow_item(
+                                buf, tuple(crops[j]), bool(flips[j]))
+                    record_stats(t1 - t0, t2 - t1)
+                    out_q.put((images, labels))
+                    continue
                 t0 = _time.perf_counter()
                 bufs, labels, crops, flips, slow = [], [], [], [], {}
                 for raw in chunk:
@@ -375,14 +428,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                     DEFAULT_IMAGE_SIZE, CHANNEL_MEANS, num_threads=1,
                     fast_dct=fast_dct, scaled_decode=scaled_decode)
                 t2 = _time.perf_counter()
-                if stats is not None:
-                    # dict read-modify-write is NOT atomic across
-                    # threads — serialize the accumulation
-                    with stats_lock:
-                        stats["py_s"] = stats.get("py_s", 0.0) + (t1 - t0)
-                        stats["native_s"] = (stats.get("native_s", 0.0)
-                                             + (t2 - t1))
-                        stats["batches"] = stats.get("batches", 0) + 1
+                record_stats(t1 - t0, t2 - t1)
                 for j, img in slow.items():
                     images[j] = img
                 for j in np.nonzero(~ok)[0]:

@@ -63,6 +63,15 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p,
         f32p, u8p, ctypes.c_int, ctypes.c_int]
     lib.dtf_jpeg_eval_batch.restype = ctypes.c_int
+    if hasattr(lib, "dtf_train_example_batch"):
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.dtf_train_example_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, f32p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p, i32p, i32p,
+            u8p, u8p]
+        lib.dtf_train_example_batch.restype = ctypes.c_int
     _lib = lib
     return _lib
 

@@ -10,6 +10,7 @@
 // Exposed as a plain C ABI consumed with ctypes (no pybind11 in this
 // environment).  Build: `make -C dtf_tpu/native`.
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -17,6 +18,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string_view>
 #include <thread>
 #include <vector>
 
@@ -308,14 +310,54 @@ static void bilinear_sample_sub(const uint8_t* src, int sh, int sw,
   }
 }
 
-// scaled_decode: crops larger than the output decode at the smallest
-// N/8 DCT-space scale (libjpeg-turbo scale_num=N, N in 1..7) that
-// keeps the scaled crop >= the output — e.g. a 375px crop resized to
-// 224 decodes at 5/8 resolution.  IDCT work scales ~(N/8)² and the
-// bilinear pass reads correspondingly fewer source pixels.  The scaled
-// crop never undershoots the target, so this only changes the
-// downsampling filter chain (DCT-space scaling + bilinear vs pure
-// bilinear); the test suite bounds the numeric delta.
+// One image: fused decode-crop-(flip)-resize-mean-subtract.  With
+// scaled_decode, crops larger than the output decode at the smallest
+// N/8 DCT-space scale (libjpeg-turbo scale_num=N) that keeps the
+// scaled crop >= the output — engaged only for N <= 4 (crop >= 2x the
+// output): measured on libjpeg-turbo, N=5..7 scaled decodes LOSE to
+// the full decode (no SIMD for the odd reduced IDCT sizes, and entropy
+// decode — the constant cost scaling can't skip — dominates small
+// images), while N<=4 wins 10-30%.  Returns 0 on success.
+static int decode_resize_one(const uint8_t* buf, int64_t len, int y, int x,
+                             int ch, int cw, int flip, int oh, int ow,
+                             const float* sub, float* dst, int fast_dct,
+                             int scaled_decode, std::vector<uint8_t>& tmp) {
+  if (ch <= 0 || cw <= 0) return 1;
+  int num = 8;
+  if (scaled_decode) {
+    const int n_h = (8 * oh + ch - 1) / ch;
+    const int n_w = (8 * ow + cw - 1) / cw;
+    const int nsel = n_h > n_w ? n_h : n_w;
+    if (nsel >= 1 && nsel <= 4) num = nsel;
+  }
+  const float ys = static_cast<float>(ch) / oh;
+  const float xs = static_cast<float>(cw) / ow;
+  if (num == 8) {
+    tmp.resize(static_cast<size_t>(ch) * cw * 3);
+    if (jpeg_decode_crop_impl(buf, len, y, x, ch, cw, tmp.data(),
+                              fast_dct))
+      return 1;
+    bilinear_sample_sub(tmp.data(), ch, cw, dst, oh, ow, flip,
+                        0.5f * ys - 0.5f, ys, 0.5f * xs - 0.5f, xs, sub);
+  } else {
+    // decode window in N/8-scaled coordinates covering the crop
+    const float s = num / 8.0f;
+    const int y0s = y * num / 8, x0s = x * num / 8;
+    const int chs = ((y + ch) * num + 7) / 8 - y0s;
+    const int cws = ((x + cw) * num + 7) / 8 - x0s;
+    tmp.resize(static_cast<size_t>(chs) * cws * 3);
+    if (jpeg_decode_crop_impl(buf, len, y0s, x0s, chs, cws, tmp.data(),
+                              fast_dct, num))
+      return 1;
+    // full-res source coord f sits at (f + 0.5)*s - 0.5 in scaled
+    // space; carry the crop origin and window offset through
+    bilinear_sample_sub(tmp.data(), chs, cws, dst, oh, ow, flip,
+                        (y + 0.5f * ys) * s - 0.5f - y0s, ys * s,
+                        (x + 0.5f * xs) * s - 0.5f - x0s, xs * s, sub);
+  }
+  return 0;
+}
+
 int dtf_jpeg_decode_crop_resize_batch(
     const uint8_t** bufs, const int64_t* lens, int n, const int* crops,
     const uint8_t* flips, int oh, int ow, const float* sub, float* out,
@@ -327,60 +369,337 @@ int dtf_jpeg_decode_crop_resize_batch(
       int i = next.fetch_add(1);
       if (i >= n) return;
       const int* c = crops + i * 4;
-      const int y = c[0], x = c[1], ch = c[2], cw = c[3];
-      if (ch <= 0 || cw <= 0) {
+      float* dst = out + static_cast<size_t>(i) * oh * ow * 3;
+      if (decode_resize_one(bufs[i], lens[i], c[0], c[1], c[2], c[3],
+                            flips ? flips[i] : 0, oh, ow, sub, dst,
+                            fast_dct, scaled_decode, tmp)) {
         statuses[i] = 1;
         failures.fetch_add(1);
         continue;
       }
-      int num = 8;
-      if (scaled_decode) {
-        // smallest N with N/8 >= max(oh/ch, ow/cw) — scaled crop
-        // stays >= the output, so the bilinear pass only ever shrinks.
-        // Engage only for N <= 4 (crop >= 2x the output): measured on
-        // libjpeg-turbo, N=5..7 scaled decodes LOSE to the full decode
-        // (no SIMD for the odd reduced IDCT sizes, and entropy decode
-        // — the constant cost scaling can't skip — dominates small
-        // images), while N<=4 wins 10-30%.
-        const int n_h = (8 * oh + ch - 1) / ch;
-        const int n_w = (8 * ow + cw - 1) / cw;
-        const int nsel = n_h > n_w ? n_h : n_w;
-        if (nsel >= 1 && nsel <= 4) num = nsel;
+      statuses[i] = 0;
+    }
+  };
+  if (num_threads <= 1) {
+    work();
+  } else {
+    std::vector<std::thread> threads;
+    for (int t = 0; t < num_threads; t++) threads.emplace_back(work);
+    for (auto& t : threads) t.join();
+  }
+  return failures.load();
+}
+
+// ---------------------------------------------------------------------------
+// tf.train.Example wire parse (targeted) + distorted-bbox sampling —
+// the whole per-record train path in one call: parse → JPEG header →
+// sample crop → flip → fused decode-crop-resize-subtract.  This is the
+// GIL-held Python work the r3 instrumentation measured as the input
+// pipeline's Amdahl serial fraction, moved off the interpreter.
+//
+// Wire format (records.py build_example / TF parity): Example{1:
+// Features{1: map entry{1: key, 2: Feature}}}; Feature{1: BytesList,
+// 2: FloatList (packed), 3: Int64List (packed varints)}.
+// ---------------------------------------------------------------------------
+
+// Reads a base-128 varint; returns false on truncation.
+static bool read_varint(const uint8_t*& p, const uint8_t* end,
+                        uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t b = *p++;
+    v |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Skips a field payload by wiretype; returns false on malformed input.
+static bool skip_field(const uint8_t*& p, const uint8_t* end, int wt) {
+  uint64_t tmp;
+  switch (wt) {
+    case 0: return read_varint(p, end, &tmp);
+    case 1: if (end - p < 8) return false; p += 8; return true;
+    case 2:
+      if (!read_varint(p, end, &tmp) ||
+          static_cast<uint64_t>(end - p) < tmp)
+        return false;
+      p += tmp;
+      return true;
+    case 5: if (end - p < 4) return false; p += 4; return true;
+    default: return false;
+  }
+}
+
+struct ParsedExample {
+  const uint8_t* encoded = nullptr;  // points into the record buffer
+  uint64_t encoded_len = 0;
+  int64_t label = -1;
+  float bbox[4] = {0.f, 0.f, 1.f, 1.f};  // ymin, xmin, ymax, xmax
+  bool has_bbox = false;
+};
+
+// Extracts the first value of the named features.  Returns false on a
+// wire-format error or when image/encoded / label are absent.
+static bool parse_train_example(const uint8_t* rec, int64_t len,
+                                ParsedExample* out) {
+  const uint8_t* p = rec;
+  const uint8_t* end = rec + len;
+  bool bbox_seen[4] = {false, false, false, false};
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    if ((tag >> 3) != 1 || (tag & 7) != 2) {  // Example.features
+      if (!skip_field(p, end, tag & 7)) return false;
+      continue;
+    }
+    uint64_t flen;
+    if (!read_varint(p, end, &flen) ||
+        static_cast<uint64_t>(end - p) < flen)
+      return false;
+    const uint8_t* fp = p;
+    const uint8_t* fend = p + flen;
+    p = fend;
+    while (fp < fend) {  // Features.feature map entries
+      uint64_t etag;
+      if (!read_varint(fp, fend, &etag)) return false;
+      if ((etag >> 3) != 1 || (etag & 7) != 2) {
+        if (!skip_field(fp, fend, etag & 7)) return false;
+        continue;
       }
-      const float ys = static_cast<float>(ch) / oh;
-      const float xs = static_cast<float>(cw) / ow;
+      uint64_t elen;
+      if (!read_varint(fp, fend, &elen) ||
+          static_cast<uint64_t>(fend - fp) < elen)
+        return false;
+      const uint8_t* ep = fp;
+      const uint8_t* eend = fp + elen;
+      fp = eend;
+      const uint8_t* key = nullptr;
+      uint64_t key_len = 0;
+      const uint8_t* feat = nullptr;
+      uint64_t feat_len = 0;
+      while (ep < eend) {  // map entry: key=1, Feature=2
+        uint64_t ktag;
+        if (!read_varint(ep, eend, &ktag)) return false;
+        if ((ktag & 7) != 2) {
+          if (!skip_field(ep, eend, ktag & 7)) return false;
+          continue;
+        }
+        uint64_t klen;
+        if (!read_varint(ep, eend, &klen) ||
+            static_cast<uint64_t>(eend - ep) < klen)
+          return false;
+        if ((ktag >> 3) == 1) {
+          key = ep;
+          key_len = klen;
+        } else if ((ktag >> 3) == 2) {
+          feat = ep;
+          feat_len = klen;
+        }
+        ep += klen;
+      }
+      if (!key || !feat) continue;
+      std::string_view name(reinterpret_cast<const char*>(key), key_len);
+      int bbox_idx = -1;
+      if (name == "image/object/bbox/ymin") bbox_idx = 0;
+      else if (name == "image/object/bbox/xmin") bbox_idx = 1;
+      else if (name == "image/object/bbox/ymax") bbox_idx = 2;
+      else if (name == "image/object/bbox/xmax") bbox_idx = 3;
+      if (name != "image/encoded" && name != "image/class/label" &&
+          bbox_idx < 0)
+        continue;
+      // Feature: one of BytesList/FloatList/Int64List at field 1..3
+      const uint8_t* vp = feat;
+      const uint8_t* vend = feat + feat_len;
+      while (vp < vend) {
+        uint64_t vtag;
+        if (!read_varint(vp, vend, &vtag)) return false;
+        if ((vtag & 7) != 2) {
+          if (!skip_field(vp, vend, vtag & 7)) return false;
+          continue;
+        }
+        uint64_t vlen;
+        if (!read_varint(vp, vend, &vlen) ||
+            static_cast<uint64_t>(vend - vp) < vlen)
+          return false;
+        const uint8_t* lp = vp;
+        const uint8_t* lend = vp + vlen;
+        vp = lend;
+        // the list message: field 1 holds the value(s)
+        while (lp < lend) {
+          uint64_t ltag;
+          if (!read_varint(lp, lend, &ltag)) return false;
+          if ((ltag >> 3) != 1) {
+            if (!skip_field(lp, lend, ltag & 7)) return false;
+            continue;
+          }
+          if ((vtag >> 3) == 1 && (ltag & 7) == 2) {  // bytes value
+            uint64_t blen;
+            if (!read_varint(lp, lend, &blen) ||
+                static_cast<uint64_t>(lend - lp) < blen)
+              return false;
+            if (name == "image/encoded" && !out->encoded) {
+              out->encoded = lp;
+              out->encoded_len = blen;
+            }
+            lp += blen;
+          } else if ((vtag >> 3) == 2) {  // float list
+            if ((ltag & 7) == 2) {  // packed
+              uint64_t plen;
+              if (!read_varint(lp, lend, &plen) ||
+                  static_cast<uint64_t>(lend - lp) < plen || plen < 4)
+                return false;
+              if (bbox_idx >= 0 && !bbox_seen[bbox_idx]) {
+                memcpy(&out->bbox[bbox_idx], lp, 4);  // first value
+                bbox_seen[bbox_idx] = true;
+              }
+              lp += plen;
+            } else if ((ltag & 7) == 5) {  // unpacked
+              if (lend - lp < 4) return false;
+              if (bbox_idx >= 0 && !bbox_seen[bbox_idx]) {
+                memcpy(&out->bbox[bbox_idx], lp, 4);
+                bbox_seen[bbox_idx] = true;
+              }
+              lp += 4;
+            } else {
+              if (!skip_field(lp, lend, ltag & 7)) return false;
+            }
+          } else if ((vtag >> 3) == 3) {  // int64 list
+            if ((ltag & 7) == 2) {  // packed varints
+              uint64_t plen;
+              if (!read_varint(lp, lend, &plen) ||
+                  static_cast<uint64_t>(lend - lp) < plen)
+                return false;
+              const uint8_t* ip = lp;
+              uint64_t v;
+              if (name == "image/class/label" && out->label < 0 &&
+                  read_varint(ip, lp + plen, &v))
+                out->label = static_cast<int64_t>(v);
+              lp += plen;
+            } else if ((ltag & 7) == 0) {  // single varint
+              uint64_t v;
+              if (!read_varint(lp, lend, &v)) return false;
+              if (name == "image/class/label" && out->label < 0)
+                out->label = static_cast<int64_t>(v);
+            } else {
+              if (!skip_field(lp, lend, ltag & 7)) return false;
+            }
+          } else {
+            if (!skip_field(lp, lend, ltag & 7)) return false;
+          }
+        }
+      }
+    }
+  }
+  out->has_bbox = bbox_seen[0] && bbox_seen[1] && bbox_seen[2] &&
+                  bbox_seen[3];
+  return out->encoded != nullptr && out->label >= 0;
+}
+
+// splitmix64: per-image deterministic stream independent of thread
+// scheduling (seed ^ f(index) — stronger reproducibility than a
+// shared sequential generator).
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    s += 0x9E3779B97F4A7C15ull;
+    uint64_t z = s;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
+  double uniform() {  // [0, 1)
+    return (next() >> 11) * 0x1.0p-53;
+  }
+  double uniform(double lo, double hi) { return lo + (hi - lo) * uniform(); }
+  int64_t below(int64_t n) {  // [0, n)
+    return static_cast<int64_t>(uniform() * n);
+  }
+};
+
+// Mirror of data/imagenet.py sample_distorted_bbox (reference
+// imagenet_preprocessing.py:345-361 constants): min_object_covered
+// 0.1, aspect in [0.75, 1.33], area in [0.05, 1.0], 100 attempts,
+// whole image on failure.
+static void sample_distorted_bbox(Rng& rng, int height, int width,
+                                  const float* bbox, bool has_bbox,
+                                  int* out) {
+  const float by0 = (has_bbox ? bbox[0] : 0.f) * height;
+  const float bx0 = (has_bbox ? bbox[1] : 0.f) * width;
+  const float by1 = (has_bbox ? bbox[2] : 1.f) * height;
+  const float bx1 = (has_bbox ? bbox[3] : 1.f) * width;
+  const float box_area =
+      std::max((by1 - by0) * (bx1 - bx0), 1e-6f);
+  for (int attempt = 0; attempt < 100; ++attempt) {
+    const double aspect = rng.uniform(0.75, 1.33);
+    const double area_frac = rng.uniform(0.05, 1.0);
+    const double target_area =
+        area_frac * static_cast<double>(height) * width;
+    const int w = static_cast<int>(std::lround(std::sqrt(target_area * aspect)));
+    const int h = static_cast<int>(std::lround(std::sqrt(target_area / aspect)));
+    if (w > width || h > height || h <= 0 || w <= 0) continue;
+    const int y = static_cast<int>(rng.below(height - h + 1));
+    const int x = static_cast<int>(rng.below(width - w + 1));
+    const float inter_h =
+        std::max(0.f, std::min<float>(y + h, by1) - std::max<float>(y, by0));
+    const float inter_w =
+        std::max(0.f, std::min<float>(x + w, bx1) - std::max<float>(x, bx0));
+    if (inter_h * inter_w / box_area >= 0.1f) {
+      out[0] = y; out[1] = x; out[2] = h; out[3] = w;
+      return;
+    }
+  }
+  out[0] = 0; out[1] = 0; out[2] = height; out[3] = width;
+}
+
+// The whole train path for a batch of raw Example records.  statuses:
+// 0 ok, 1 parse failed (caller reprocesses in Python), 2 decode failed
+// (caller re-decodes with the RETURNED crop/flip so augmentation stays
+// identical).  labels/crops/flips are always filled for status != 1.
+// Returns the failure count.
+int dtf_train_example_batch(
+    const uint8_t** recs, const int64_t* lens, int n, uint64_t seed,
+    int oh, int ow, const float* sub, int fast_dct, int scaled_decode,
+    int num_threads, float* out, int32_t* labels, int32_t* crops,
+    uint8_t* flips, uint8_t* statuses) {
+  std::atomic<int> next(0), failures(0);
+  auto work = [&]() {
+    std::vector<uint8_t> tmp;
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      ParsedExample ex;
+      if (!parse_train_example(recs[i], lens[i], &ex)) {
+        statuses[i] = 1;
+        failures.fetch_add(1);
+        continue;
+      }
+      labels[i] = static_cast<int32_t>(ex.label - 1);  // → [0, 1000)
+      int h = 0, w = 0;
+      Rng rng(seed ^ (0xA0761D6478BD642Full * (i + 1)));
+      int* crop = crops + i * 4;
+      if (dtf_jpeg_shape(ex.encoded, ex.encoded_len, &h, &w) ||
+          h <= 0 || w <= 0) {
+        statuses[i] = 1;  // undecodable header → Python whole path
+        failures.fetch_add(1);
+        continue;
+      }
+      sample_distorted_bbox(rng, h, w, ex.bbox, ex.has_bbox, crop);
+      const int flip = rng.uniform() < 0.5 ? 1 : 0;
+      flips[i] = static_cast<uint8_t>(flip);
       float* dst = out + static_cast<size_t>(i) * oh * ow * 3;
-      const int flip = flips ? flips[i] : 0;
-      if (num == 8) {
-        tmp.resize(static_cast<size_t>(ch) * cw * 3);
-        if (jpeg_decode_crop_impl(bufs[i], lens[i], y, x, ch, cw,
-                                  tmp.data(), fast_dct)) {
-          statuses[i] = 1;
-          failures.fetch_add(1);
-          continue;
-        }
-        bilinear_sample_sub(tmp.data(), ch, cw, dst, oh, ow, flip,
-                            0.5f * ys - 0.5f, ys, 0.5f * xs - 0.5f, xs,
-                            sub);
-      } else {
-        // decode window in N/8-scaled coordinates covering the crop
-        const float s = num / 8.0f;
-        const int y0s = y * num / 8, x0s = x * num / 8;
-        const int chs = ((y + ch) * num + 7) / 8 - y0s;
-        const int cws = ((x + cw) * num + 7) / 8 - x0s;
-        tmp.resize(static_cast<size_t>(chs) * cws * 3);
-        if (jpeg_decode_crop_impl(bufs[i], lens[i], y0s, x0s, chs, cws,
-                                  tmp.data(), fast_dct, num)) {
-          statuses[i] = 1;
-          failures.fetch_add(1);
-          continue;
-        }
-        // full-res source coord f sits at (f + 0.5)*s - 0.5 in scaled
-        // space; carry the crop origin and window offset through
-        bilinear_sample_sub(tmp.data(), chs, cws, dst, oh, ow, flip,
-                            (y + 0.5f * ys) * s - 0.5f - y0s, ys * s,
-                            (x + 0.5f * xs) * s - 0.5f - x0s, xs * s,
-                            sub);
+      if (decode_resize_one(ex.encoded, ex.encoded_len, crop[0], crop[1],
+                            crop[2], crop[3], flip, oh, ow, sub, dst,
+                            fast_dct, scaled_decode, tmp)) {
+        statuses[i] = 2;
+        failures.fetch_add(1);
+        continue;
       }
       statuses[i] = 0;
     }

@@ -124,6 +124,49 @@ def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
     return out, statuses == 0
 
 
+def train_example_batch(records, seed: int, out_h: int, out_w: int, sub,
+                        num_threads: int = 4, fast_dct: bool = False,
+                        scaled_decode: bool = False):
+    """The whole train path for a batch of raw tf.train.Example
+    records in one C++ call: proto parse (image/encoded, label, first
+    bbox) → JPEG header → distorted-bbox sampling (reference
+    constants; splitmix64 per-image streams seeded by ``seed``) →
+    flip → fused decode-crop-resize-mean-subtract.  This is the
+    formerly GIL-held per-record Python work (the input pipeline's
+    measured Amdahl serial fraction), off the interpreter.
+
+    Returns (images f32 [n,oh,ow,3], labels i32 [n] (shifted to
+    [0,1000)), crops i32 [n,4], flips u8 [n], statuses u8 [n]):
+    status 0 ok; 1 parse/header failure (reprocess the record in
+    Python); 2 decode failure (re-decode with the returned crop/flip
+    so the augmentation stays identical).
+    """
+    lib = _lib()
+    if not hasattr(lib, "dtf_train_example_batch"):
+        raise ImportError("libdtf_native.so predates "
+                          "dtf_train_example_batch; rebuild")
+    n = len(records)
+    out = np.empty((n, out_h, out_w, 3), np.float32)
+    labels = np.empty((n,), np.int32)
+    crops = np.empty((n, 4), np.int32)
+    flips = np.empty((n,), np.uint8)
+    statuses = np.empty((n,), np.uint8)
+    rec_ptrs = (ctypes.c_char_p * n)(*records)
+    lens = (ctypes.c_int64 * n)(*[len(r) for r in records])
+    sub_arr = np.ascontiguousarray(np.asarray(sub, np.float32))
+    lib.dtf_train_example_batch(
+        rec_ptrs, lens, n, ctypes.c_uint64(seed & (2**64 - 1)),
+        out_h, out_w,
+        sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(fast_dct), int(scaled_decode), num_threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        crops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out, labels, crops, flips, statuses
+
+
 def eval_batch(bufs, resize_min: int, out_h: int, out_w: int, sub,
                num_threads: int = 4, fast_dct: bool = False):
     """Fused eval preprocessing for a batch: aspect-preserving resize to

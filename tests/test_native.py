@@ -279,6 +279,114 @@ def test_decode_crop_resize_batch_flags_bad_images():
     assert np.isfinite(out[0]).all()
 
 
+def _train_example(rng, h, w, label, bbox=None):
+    from dtf_tpu.data import records
+    arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    feats = {"image/encoded": _jpeg(arr),
+             "image/class/label": [int(label)]}
+    if bbox is not None:
+        ymin, xmin, ymax, xmax = bbox
+        feats.update({
+            "image/object/bbox/ymin": [float(ymin)],
+            "image/object/bbox/xmin": [float(xmin)],
+            "image/object/bbox/ymax": [float(ymax)],
+            "image/object/bbox/xmax": [float(xmax)],
+        })
+    return records.build_example(feats)
+
+
+def _has_train_batch():
+    from dtf_tpu.native import load
+    lib = load()
+    return lib is not None and hasattr(lib, "dtf_train_example_batch")
+
+
+@pytest.mark.skipif(not native.available() or not _has_train_batch(),
+                    reason="dtf_train_example_batch not built")
+def test_train_example_batch_end_to_end():
+    """The fully-native train path (proto parse → sample → decode)
+    produces images identical to the two-step path given the crops and
+    flips it reports, correct shifted labels, and in-bounds crops."""
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(31)
+    dims = [(int(rng.integers(80, 140)), int(rng.integers(90, 150)))
+            for _ in range(8)]
+    recs = [_train_example(rng, h, w, 1 + i) for i, (h, w) in
+            enumerate(dims)]
+    sub = np.array([123.68, 116.78, 103.94], np.float32)
+    images, labels, crops, flips, st = jpeg.train_example_batch(
+        recs, seed=7, out_h=64, out_w=64, sub=sub, num_threads=2)
+    assert (st == 0).all()
+    np.testing.assert_array_equal(labels, np.arange(8, dtype=np.int32))
+    for i, (h, w) in enumerate(dims):
+        y, x, ch, cw = crops[i]
+        assert 0 <= y and 0 <= x and y + ch <= h and x + cw <= w
+        assert ch > 0 and cw > 0
+    # identical images from the two-step op with the same crops/flips
+    from dtf_tpu.data import records as rec_mod
+    bufs = [rec_mod.parse_example(r)["image/encoded"][0] for r in recs]
+    ref, ok = jpeg.decode_crop_resize_batch(
+        bufs, [tuple(c) for c in crops], list(flips), 64, 64, sub)
+    assert ok.all()
+    np.testing.assert_array_equal(images, ref)
+    # determinism: same seed → same everything
+    images2, labels2, crops2, flips2, st2 = jpeg.train_example_batch(
+        recs, seed=7, out_h=64, out_w=64, sub=sub, num_threads=1)
+    np.testing.assert_array_equal(images, images2)
+    np.testing.assert_array_equal(crops, crops2)
+    np.testing.assert_array_equal(flips, flips2)
+    # different seed → different crops somewhere
+    _, _, crops3, _, _ = jpeg.train_example_batch(
+        recs, seed=8, out_h=64, out_w=64, sub=sub)
+    assert (np.asarray(crops3) != np.asarray(crops)).any()
+
+
+@pytest.mark.skipif(not native.available() or not _has_train_batch(),
+                    reason="dtf_train_example_batch not built")
+def test_train_example_batch_bbox_coverage():
+    """Sampled crops respect min_object_covered=0.1 against the first
+    bbox (the reference sampler's constraint)."""
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(32)
+    h = w = 200
+    bbox = (0.4, 0.4, 0.6, 0.6)
+    recs = [_train_example(rng, h, w, 5, bbox=bbox) for _ in range(16)]
+    sub = np.zeros(3, np.float32)
+    _, _, crops, _, st = jpeg.train_example_batch(
+        recs, seed=3, out_h=32, out_w=32, sub=sub)
+    assert (st == 0).all()
+    by0, bx0, by1, bx1 = [v * h for v in bbox]
+    box_area = (by1 - by0) * (bx1 - bx0)
+    for y, x, ch, cw in np.asarray(crops):
+        if (y, x, ch, cw) == (0, 0, h, w):
+            continue  # whole-image fallback is always legal
+        inter_h = max(0.0, min(y + ch, by1) - max(y, by0))
+        inter_w = max(0.0, min(x + cw, bx1) - max(x, bx0))
+        assert inter_h * inter_w / box_area >= 0.1
+
+
+@pytest.mark.skipif(not native.available() or not _has_train_batch(),
+                    reason="dtf_train_example_batch not built")
+def test_train_example_batch_flags_bad_records():
+    """Garbage records report status 1 (parse) and good neighbors
+    still process; a record with a corrupt JPEG reports its crop for
+    the Python re-decode."""
+    from dtf_tpu.native import jpeg
+    rng = np.random.default_rng(33)
+    good = _train_example(rng, 100, 120, 7)
+    from dtf_tpu.data import records
+    bad_jpeg = records.build_example({
+        "image/encoded": b"\xff\xd8 not a jpeg",
+        "image/class/label": [3]})
+    images, labels, crops, flips, st = jpeg.train_example_batch(
+        [good, b"not a proto", bad_jpeg], seed=1, out_h=32, out_w=32,
+        sub=np.zeros(3, np.float32))
+    assert st[0] == 0 and np.isfinite(images[0]).all()
+    assert st[1] == 1
+    assert st[2] == 1  # header unreadable → python whole path
+    assert labels[0] == 6
+
+
 def test_tfrecord_reader_rejects_absurd_length(tmp_path):
     """A corrupt length field must raise, not abort the process."""
     path = str(tmp_path / "huge.tfrecord")
