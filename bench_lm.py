@@ -66,7 +66,9 @@ def _sync(x):
     return float(jax.device_get(x))
 
 
-def build_trainer(batch: int, remat: bool):
+def build_trainer(batch: int, remat: bool, seq: int = SEQ):
+    import dataclasses
+
     from dtf_tpu.config import Config
     from dtf_tpu.data.base import LM
     from dtf_tpu.models import build_model
@@ -81,21 +83,26 @@ def build_trainer(batch: int, remat: bool):
     rt.shard_seq = True
     model, _ = build_model("transformer", num_classes=VOCAB,
                            dtype=jnp.bfloat16, num_layers=12, d_model=768,
-                           num_heads=12, d_ff=3072, max_seq_len=SEQ,
+                           num_heads=12, d_ff=3072, max_seq_len=seq,
                            remat=remat)
-    trainer = Trainer(cfg, rt, model, 0.0, LM)
+    trainer = Trainer(cfg, rt, model, 0.0,
+                      dataclasses.replace(LM, seq_len=seq))
     return trainer, rt
 
 
-def train_bench(remat: bool, warmup: int = 3, iters: int = 10):
+def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
+                seq: int = SEQ):
     n_chips = len(jax.devices())
     err = None
-    for per_chip in (16, 8, 4):
+    # per-chip batch candidates scale down with sequence length
+    cands = [max(1, 16 * SEQ // seq), max(1, 8 * SEQ // seq),
+             max(1, 4 * SEQ // seq)]
+    for per_chip in dict.fromkeys(cands):
         batch = per_chip * n_chips
         try:
-            trainer, rt = build_trainer(batch, remat)
+            trainer, rt = build_trainer(batch, remat, seq)
             rng = np.random.default_rng(0)
-            tokens = rng.integers(0, VOCAB, (batch, SEQ)).astype(np.int32)
+            tokens = rng.integers(0, VOCAB, (batch, seq)).astype(np.int32)
             labels = np.roll(tokens, -1, axis=1)
             state = trainer.init_state(jax.random.key(0), (tokens, labels))
             sharded = rt.shard_batch((tokens, labels))
@@ -122,7 +129,7 @@ def train_bench(remat: bool, warmup: int = 3, iters: int = 10):
             assert np.isfinite(loss), f"non-finite loss {loss}"
 
             step_s = elapsed / iters
-            tokens_per_sec = batch * SEQ / step_s
+            tokens_per_sec = batch * seq / step_s
             per_chip_tps = tokens_per_sec / n_chips
             peak = peak_tflops(jax.devices()[0])
             mfu = ((step_flops / step_s) / (peak * 1e12)
@@ -131,7 +138,8 @@ def train_bench(remat: bool, warmup: int = 3, iters: int = 10):
                       if peak else None)
             return dict(per_chip_tps=per_chip_tps, step_ms=step_s * 1e3,
                         mfu=mfu, mfu_6n=mfu_6n, n_params=n_params,
-                        per_chip_batch=per_chip, n_chips=n_chips)
+                        per_chip_batch=per_chip, n_chips=n_chips,
+                        seq=seq)
         except Exception as e:
             if not is_oom(e):
                 raise
@@ -300,6 +308,13 @@ def main():
     if "--variant" in sys.argv:
         variant = sys.argv[sys.argv.index("--variant") + 1]
     remat = "--remat" in sys.argv
+    seq = SEQ
+    if "--seq" in sys.argv:
+        i = sys.argv.index("--seq")
+        if i + 1 >= len(sys.argv):
+            sys.exit("usage: bench_lm.py [--seq N] [--remat] "
+                     "[--variant flash|gpipe|gpipe_mem]")
+        seq = int(sys.argv[i + 1])
 
     if variant == "flash":
         r = flash_bench()
@@ -342,21 +357,24 @@ def main():
         }))
         return
 
-    r = train_bench(remat)
+    r = train_bench(remat, seq=seq)
     base = R2_REMAT_TOKENS_PER_SEC if remat else R2_TOKENS_PER_SEC
     print(json.dumps({
         "metric": ("lm_tokens_per_sec_per_chip_remat" if remat
                    else "lm_tokens_per_sec_per_chip"),
         "value": round(r["per_chip_tps"], 0),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(r["per_chip_tps"] / base, 2),
+        # round-over-round baseline is the seq-2048 recipe; other seqs
+        # have no baseline
+        "vs_baseline": (round(r["per_chip_tps"] / base, 2)
+                        if seq == SEQ else None),
         "step_ms": round(r["step_ms"], 2),
         "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
         "mfu_6n": round(r["mfu_6n"], 4) if r["mfu_6n"] is not None else None,
         "n_params": r["n_params"],
         "per_chip_batch": r["per_chip_batch"],
         "n_chips": r["n_chips"],
-        "seq_len": SEQ,
+        "seq_len": seq,
         "remat": remat,
         "device_kind": jax.devices()[0].device_kind,
     }))
