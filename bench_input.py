@@ -44,14 +44,18 @@ MEASURE_IMAGES = 1600
 CHIP_DEMAND = 2430.0  # img/s one chip consumes (BENCH_r02 measurement)
 
 
-def make_shards(root: str):
+def make_shards(root: str, num_shards: int = NUM_SHARDS,
+                images_per_shard: int = IMAGES_PER_SHARD):
+    """Synthetic ImageNet-shaped JPEG TFRecord shards (~500×375,
+    quality 90) in the production train-%05d-of-01024 layout.  Also
+    used by run_record.py so the recorded-run evidence and this bench
+    measure the same data recipe."""
     from PIL import Image
     from dtf_tpu.data import records
     rng = np.random.default_rng(0)
-    for shard in range(NUM_SHARDS):
+    for shard in range(num_shards):
         recs = []
-        for _ in range(IMAGES_PER_SHARD):
-            # ImageNet-ish JPEG: ~500×375, quality 90
+        for _ in range(images_per_shard):
             h, w = int(rng.integers(350, 420)), int(rng.integers(450, 550))
             arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
             buf = io.BytesIO()
@@ -77,11 +81,15 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
                                process_count=1, fast_dct=fast_dct,
                                scaled_decode=scaled_decode, stats=stats)
         # warmup: first batches pay thread spin-up + shuffle-buffer fill.
-        # Snapshot-and-subtract instead of clear(): workers update stats
-        # under their own lock, so mutating the dict from here races
+        # Snapshot-and-subtract instead of clear(), under the writers'
+        # lock (published by the pipeline in stats["lock"]) so the
+        # (py_s, native_s, batches) triple is never read torn
+        import threading
         for _ in range(4):
             next(it)
-        warm = dict(stats)
+        lock = stats.get("lock") or threading.Lock()
+        with lock:
+            warm = dict(stats)
         t0 = time.perf_counter()
         seen = 0
         while seen < MEASURE_IMAGES:
@@ -94,11 +102,13 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
     rate = seen / elapsed
     per_core = rate / cores
     serial_fraction = amdahl = None
-    batches = stats.get("batches", 0) - warm.get("batches", 0)
+    with lock:
+        final = dict(stats)
+    batches = final.get("batches", 0) - warm.get("batches", 0)
     if batches > 0:
-        py_per_batch = (stats.get("py_s", 0.0)
+        py_per_batch = (final.get("py_s", 0.0)
                         - warm.get("py_s", 0.0)) / batches
-        native_per_batch = (stats.get("native_s", 0.0)
+        native_per_batch = (final.get("native_s", 0.0)
                             - warm.get("native_s", 0.0)) / batches
         serial_fraction = py_per_batch / (py_per_batch + native_per_batch)
         amdahl = batch / py_per_batch

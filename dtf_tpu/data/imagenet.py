@@ -280,7 +280,11 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     raw_q: queue.Queue = queue.Queue(maxsize=SHUFFLE_BUFFER // 4)
     out_q: queue.Queue = queue.Queue(maxsize=64)
     stop = threading.Event()
+    # the lock is published through the stats dict so readers
+    # (bench_input) can snapshot consistently with the writers
     stats_lock = threading.Lock()
+    if stats is not None:
+        stats["lock"] = stats_lock
 
     # Batched native fast path (train only): the reader's shuffle buffer
     # emits whole-batch CHUNKS of raw records, and each Python worker
@@ -494,13 +498,27 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     atexit.register(_shutdown)
 
     def _teardown():
+        # Same joins as _shutdown BEFORE unregistering it: an in-flight
+        # GIL-released decode at interpreter exit is force-unwound
+        # through the C++ frames the moment no one waits for it —
+        # dropping the backstop without joining would re-open exactly
+        # the crash it exists to prevent.
         stop.set()
-        atexit.unregister(_shutdown)
-        for _ in range(num_threads):  # let workers drain out promptly
+        for _ in range(num_threads):  # wake workers stuck on get()
             try:
                 raw_q.put_nowait(None)
             except queue.Full:
                 break
+        for q in (raw_q, out_q):  # unblock producers stuck on put()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        for t in threads:
+            t.join(timeout=5.0)
+        if not any(t.is_alive() for t in threads):
+            atexit.unregister(_shutdown)  # else keep the backstop
 
     def gen_native():
         done_workers = 0

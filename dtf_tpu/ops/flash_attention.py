@@ -402,8 +402,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
         use_pallas = jax.default_backend() == "tpu"
     scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     if not use_pallas:
+        bk = block_k if block_k is not None else math.gcd(
+            DEFAULT_BLOCK_K, k.shape[1])
         return bw.blockwise_attention(q, k, v, causal=causal, scale=scale,
-                                      block_k=block_k or DEFAULT_BLOCK_K)
+                                      block_k=bk)
 
     interpret = use_pallas == "interpret"
     b, sq, h, d = q.shape
@@ -414,6 +416,15 @@ def flash_attention(q, k, v, *, causal: bool = False,
         block_k = math.gcd(DEFAULT_BLOCK_K, sk)
     block_q = max(min(block_q, sq), 1)
     block_k = max(min(block_k, sk), 1)
+    if not interpret and (block_q < 8 or block_k < 8):
+        # DEFAULT blocks are powers of two, so the gcd auto-shrink
+        # lands on a power of two: anything below 8 violates the TPU
+        # (8, 128) tile rule and would die opaquely in Mosaic lowering
+        raise ValueError(
+            f"auto block sizes ({block_q}, {block_k}) fell below the "
+            f"TPU tile minimum of 8 for seq lengths ({sq}, {sk}); pad "
+            f"the sequence to a multiple of 8 or pass explicit "
+            f"block_q/block_k")
     if sq % block_q or sk % block_k:
         raise ValueError(
             f"block sizes ({block_q}, {block_k}) must divide the seq "

@@ -83,25 +83,11 @@ def write_cifar_binaries(root: str, num_train: int, num_eval: int):
 
 
 def write_imagenet_shards(root: str, num_images: int, num_shards: int = 8):
-    """Synthetic JPEG TFRecord shards in the production layout."""
-    from PIL import Image
-    from dtf_tpu.data import records
-    rng = np.random.default_rng(0)
-    per = num_images // num_shards
-    for shard in range(num_shards):
-        recs = []
-        for _ in range(per):
-            h = int(rng.integers(350, 420))
-            w = int(rng.integers(450, 550))
-            arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
-            buf = io.BytesIO()
-            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
-            recs.append(records.build_example({
-                "image/encoded": buf.getvalue(),
-                "image/class/label": [int(rng.integers(1, 1001))],
-            }))
-        records.write_tfrecord_file(
-            os.path.join(root, f"train-{shard:05d}-of-01024"), recs)
+    """Synthetic JPEG TFRecord shards in the production layout — the
+    same recipe bench_input measures (shared generator)."""
+    from bench_input import make_shards
+    make_shards(root, num_shards=num_shards,
+                images_per_shard=num_images // num_shards)
 
 
 def steady_rate(stats: dict, batch_size: int):
