@@ -15,16 +15,17 @@ runs the same workload shape (ResNet-50 v1.5, 224×224, synthetic data,
 full train step incl. gradient all-reduce) on however many chips are
 attached and reports images/sec/chip.
 
-Roofline notes (v5 lite, r2 measurements): r1's 1,937 img/s was lifted
-to ~2,430 by (a) bf16 BatchNorm I/O — r1 ran BN in fp32, doubling the
-HBM traffic of every conv→BN→relu link (+20%), and (b) the
-space-to-depth stem (exact 7×7/2/3ch → 4×4/1/12ch reformulation,
-models/resnet.py Conv1SpaceToDepth, +4%).  A fwd/bwd/update split at
-batch 256 gives 37.8 / 65.6 / ~2 ms: the step is conv-compute-bound at
-~30% MFU with XLA-scheduled convs (BN/relu links between convs are
-HBM-bound and XLA already fuses them); pushing past ~30% needs
-hand-fused conv+BN+relu Pallas kernels or a layout change, not loop or
-optimizer work.
+Roofline notes (v5 lite): r1's 1,937 img/s was lifted to ~2,430-2,520
+in r2 by (a) bf16 BatchNorm I/O — r1 ran BN in fp32, doubling the HBM
+traffic of every conv→BN→relu link (+20%), and (b) the space-to-depth
+stem (exact 7×7/2/3ch → 4×4/1/12ch reformulation, models/resnet.py
+Conv1SpaceToDepth, +4%).  The r3 profile (bench_profile.py) replaced
+the r2 "conv-compute-bound" guess with a measurement: the step moves
+~79 GB and achieves 94% of the chip's HBM bandwidth — ~30% MFU IS the
+v5e bandwidth roofline for this program (the FLOP floor is only 31 ms
+of the ~103 ms step), and the optimized HLO shows BN/relu already
+fused into conv operand reads, so the lever is byte-count reduction,
+not kernels or scheduling (docs/DESIGN.md has the full table).
 """
 
 import json
