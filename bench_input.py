@@ -97,6 +97,11 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
             seen += len(labels)
         elapsed = time.perf_counter() - t0
         assert images.shape[1:] == (224, 224, 3)
+        # join the pipeline threads before returning: bench.py runs the
+        # LM bench in the same process next, and in-flight decodes from
+        # an abandoned iterator would perturb its numbers on a 1-core
+        # host (generator close → _teardown → worker joins)
+        it.close()
 
     cores = os.cpu_count() or 1
     rate = seen / elapsed

@@ -468,13 +468,12 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     for t in threads:
         t.start()
 
-    def _shutdown():
-        """Interpreter-exit backstop: if the process exits while a
-        daemon worker is inside the GIL-released C++ decode, CPython
-        force-unwinds the thread (pthread_exit) when the foreign call
-        returns — which aborts through the C++ frames (glibc
-        'FATAL: exception not rethrown').  Stop the pipeline and wait
-        for in-flight decodes instead."""
+    def _stop_pipeline():
+        """Stop threads and join them.  Order matters: DRAIN the queues
+        first (unblocking producers stuck on put()), THEN put the None
+        wake-up sentinels — draining after would consume our own
+        sentinels (or ones an exited reader left) and leave workers
+        blocked on raw_q.get() past the join timeout."""
         stop.set()
         for q in (raw_q, out_q):  # unblock producers stuck on put()
             try:
@@ -489,6 +488,15 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 break
         for t in threads:
             t.join(timeout=5.0)
+
+    def _shutdown():
+        """Interpreter-exit backstop: if the process exits while a
+        daemon worker is inside the GIL-released C++ decode, CPython
+        force-unwinds the thread (pthread_exit) when the foreign call
+        returns — which aborts through the C++ frames (glibc
+        'FATAL: exception not rethrown').  Stop the pipeline and wait
+        for in-flight decodes instead."""
+        _stop_pipeline()
 
     # Registered per pipeline, unregistered when the consuming
     # generator is exhausted or closed — a long test session creating
@@ -503,20 +511,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
         # through the C++ frames the moment no one waits for it —
         # dropping the backstop without joining would re-open exactly
         # the crash it exists to prevent.
-        stop.set()
-        for _ in range(num_threads):  # wake workers stuck on get()
-            try:
-                raw_q.put_nowait(None)
-            except queue.Full:
-                break
-        for q in (raw_q, out_q):  # unblock producers stuck on put()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-        for t in threads:
-            t.join(timeout=5.0)
+        _stop_pipeline()
         if not any(t.is_alive() for t in threads):
             atexit.unregister(_shutdown)  # else keep the backstop
 

@@ -240,14 +240,22 @@ void PsServer::handle_conn(int fd) {
         continue;
       }
       uint64_t ver = version, n = params.size();
+      // snapshot under the lock (plain vector copy, same cost as the
+      // f32 OP_PULL); the element-wise bf16 conversion runs unlocked so
+      // concurrent pushes don't serialize behind it
+      try {
+        scratch = params;
+      } catch (const std::bad_alloc&) {
+        break;
+      }
+      lk.unlock();
       try {
         scratch16.resize(n);
       } catch (const std::bad_alloc&) {
         break;
       }
       for (uint64_t i = 0; i < n; ++i)
-        scratch16[i] = f32_to_bf16(params[i]);
-      lk.unlock();
+        scratch16[i] = f32_to_bf16(scratch[i]);
       uint8_t hdr[17];
       hdr[0] = 0;
       memcpy(hdr + 1, &n, 8);

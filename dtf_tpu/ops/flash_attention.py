@@ -410,16 +410,32 @@ def flash_attention(q, k, v, *, causal: bool = False,
     interpret = use_pallas == "interpret"
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    auto_q = block_q is None
+    auto_k = block_k is None
     if block_q is None:
         block_q = math.gcd(DEFAULT_BLOCK_Q, sq)
     if block_k is None:
         block_k = math.gcd(DEFAULT_BLOCK_K, sk)
     block_q = max(min(block_q, sq), 1)
     block_k = max(min(block_k, sk), 1)
-    if not interpret and (block_q < 8 or block_k < 8):
+    # Odd seq lengths (not a multiple of 8) gcd-shrink below the TPU
+    # (8, 128) tile minimum.  A block equal to the full array dim is
+    # the one sub-8 shape Mosaic accepts (block == array dims), so
+    # auto-selection falls back to a single whole-sequence block —
+    # bounded by the scores-tile VMEM budget below; larger odd lengths
+    # raise with the pad advice.
+    _SCORES_ELEMS_MAX = 2 * 1024 * 1024  # 8 MB f32 of ~16 MB VMEM
+    if not interpret:
+        if auto_q and block_q < 8 and sq * block_k <= _SCORES_ELEMS_MAX:
+            block_q = sq
+        if auto_k and block_k < 8 and block_q * sk <= _SCORES_ELEMS_MAX:
+            block_k = sk
+    sub8_ok = lambda bq, bk: (bq >= 8 or bq == sq) and (bk >= 8 or bk == sk)
+    if not interpret and not sub8_ok(block_q, block_k):
         # DEFAULT blocks are powers of two, so the gcd auto-shrink
         # lands on a power of two: anything below 8 violates the TPU
-        # (8, 128) tile rule and would die opaquely in Mosaic lowering
+        # (8, 128) tile rule (unless block == array dim) and would die
+        # opaquely in Mosaic lowering
         raise ValueError(
             f"auto block sizes ({block_q}, {block_k}) fell below the "
             f"TPU tile minimum of 8 for seq lengths ({sq}, {sk}); pad "
