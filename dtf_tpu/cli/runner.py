@@ -60,9 +60,11 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
     elif spec.name == "cifar10":
         from dtf_tpu.data.cifar import cifar_input_fn
         fns = (
-            lambda: cifar_input_fn(cfg.data_dir, True, host_batch, seed=cfg.seed),
+            lambda: cifar_input_fn(cfg.data_dir, True, host_batch,
+                                   seed=cfg.seed, wire=cfg.input_wire),
             lambda: cifar_input_fn(cfg.data_dir, False, host_batch,
-                                   drop_remainder=cfg.drop_remainder),
+                                   drop_remainder=cfg.drop_remainder,
+                                   wire=cfg.input_wire),
         )
     elif spec.name == "imagenet":
         from dtf_tpu.data.imagenet import imagenet_input_fn
@@ -71,9 +73,11 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
                                       seed=cfg.seed,
                                       num_threads=cfg.datasets_num_private_threads,
                                       fast_dct=cfg.input_fast_dct,
-                                      scaled_decode=cfg.input_scaled_decode),
+                                      scaled_decode=cfg.input_scaled_decode,
+                                      wire=cfg.input_wire),
             lambda: imagenet_input_fn(cfg.data_dir, False, host_batch,
-                                      drop_remainder=cfg.drop_remainder),
+                                      drop_remainder=cfg.drop_remainder,
+                                      wire=cfg.input_wire),
         )
     else:
         raise ValueError(f"no input pipeline for dataset {spec.name!r}")
@@ -82,6 +86,16 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
         # NCHW from here on; the compiled steps transpose back to NHWC
         fns = tuple(_channels_first_factory(fn) for fn in fns)
     return fns
+
+
+def deferred_normalize_fn(cfg: Config, spec: DatasetSpec):
+    """The compiled-step normalization matching make_input_fns' wire:
+    under the uint8 wire the real-data pipelines ship raw pixels and
+    the Trainer normalizes on-chip; single-sourced in
+    data/normalize.py for_config so the SPMD and async-PS paths cannot
+    disagree."""
+    from dtf_tpu.data import normalize
+    return normalize.for_config(cfg, spec)
 
 
 def _channels_first_factory(fn):
@@ -215,7 +229,8 @@ def run(cfg: Config) -> dict:
         param_spec_fn = functools.partial(pipeline_param_partition_specs,
                                           pipe_axis=pipe_axis)
     trainer = Trainer(cfg, rt, model, l2, spec, param_spec_fn=param_spec_fn,
-                      vocab_axis=MODEL_AXIS if shard_vocab else None)
+                      vocab_axis=MODEL_AXIS if shard_vocab else None,
+                      normalize_fn=deferred_normalize_fn(cfg, spec))
     train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)
 
     train_iter = train_fn()

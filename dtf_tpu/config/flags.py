@@ -69,6 +69,13 @@ class Config:
     # Changes the downsampling filter chain (scaled decode + bilinear
     # vs pure bilinear) — another throughput opt-in, never a default
     input_scaled_decode: bool = False
+    # Host→device batch wire for the real-data pipelines.  "uint8"
+    # (default, TPU-native): raw pixels over the wire — 4x fewer bytes
+    # than f32 (the measured bottleneck of the r3 recorded runs) — with
+    # normalization as the first op inside the compiled step (the
+    # reference keeps it in-graph too, imagenet_preprocessing.py:
+    # 397-430).  "float32": host-side normalization (r1-r3 wire).
+    input_wire: str = "uint8"
     per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)
     tf_gpu_thread_mode: Optional[str] = None  # no-op compat
     batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)
@@ -196,6 +203,10 @@ class Config:
             raise ValueError(
                 f"pipeline_interleave must be 1 or 2, got "
                 f"{self.pipeline_interleave}")
+        if self.input_wire not in ("uint8", "float32"):
+            raise ValueError(
+                f"unknown input_wire {self.input_wire!r}; choose uint8 "
+                f"or float32")
         if self.ps_wire not in ("fp32", "bf16"):
             raise ValueError(
                 f"unknown ps_wire {self.ps_wire!r}; choose fp32 or bf16")

@@ -63,9 +63,11 @@ def write_binary_file(path: str, images: np.ndarray,
         f.write(recs.tobytes())
 
 
-def load_records(filenames) -> Tuple[np.ndarray, np.ndarray]:
-    """Parses fixed-length records → (images HWC float32, labels int32).
-    CHW→HWC transpose per reference parse_record (:43-75)."""
+def load_records(filenames, dtype=np.float32) -> Tuple[np.ndarray, np.ndarray]:
+    """Parses fixed-length records → (images HWC ``dtype``, labels
+    int32).  CHW→HWC transpose per reference parse_record (:43-75).
+    ``dtype=np.uint8`` keeps the raw pixels (the uint8-wire mode — 4x
+    less host memory and memcpy per batch)."""
     blobs = []
     for fn in filenames:
         raw = np.fromfile(fn, dtype=np.uint8)
@@ -78,14 +80,17 @@ def load_records(filenames) -> Tuple[np.ndarray, np.ndarray]:
     images = (records[:, 1:]
               .reshape(-1, NUM_CHANNELS, HEIGHT, WIDTH)
               .transpose(0, 2, 3, 1)
-              .astype(np.float32))
+              .astype(dtype, copy=False))
     return images, labels
 
 
 def augment_batch(images: np.ndarray, rng: np.random.Generator) -> np.ndarray:
-    """Vectorized pad-4 → random crop → random flip."""
+    """Vectorized pad-4 → random crop → random flip.  dtype-preserving:
+    pad/crop/flip move pixels without arithmetic, so uint8 in → uint8
+    out, bit-identical to augmenting the same pixels in float32."""
     n = images.shape[0]
-    padded = np.zeros((n, HEIGHT + 8, WIDTH + 8, NUM_CHANNELS), np.float32)
+    padded = np.zeros((n, HEIGHT + 8, WIDTH + 8, NUM_CHANNELS),
+                      images.dtype)
     padded[:, 4:4 + HEIGHT, 4:4 + WIDTH] = images
     ys = rng.integers(0, 9, n)
     xs = rng.integers(0, 9, n)
@@ -110,8 +115,17 @@ def standardize(images: np.ndarray) -> np.ndarray:
 def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
                    seed: int = 0, process_id: Optional[int] = None,
                    process_count: Optional[int] = None,
-                   drop_remainder: bool = True) -> Iterator:
+                   drop_remainder: bool = True,
+                   wire: str = "float32") -> Iterator:
     """Yields (images, labels) numpy batches; infinite for training.
+
+    ``wire``: host→device batch format.  ``"float32"`` standardizes on
+    the host (per_image_standardization, the r1-r3 behavior);
+    ``"uint8"`` ships raw augmented pixels — 4x fewer bytes over the
+    wire — and defers standardization to the compiled step
+    (data/normalize.py cifar_standardize).  The augmentation
+    (pad-crop-flip) moves pixels without arithmetic, so both wires see
+    bit-identical pixel values.
 
     Multi-process: each process loads its shard of the files
     (cifar_preprocessing.py:147-152 semantics). `batch_size` is the
@@ -130,17 +144,28 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
     process_id = jax.process_index() if process_id is None else process_id
     process_count = (jax.process_count() if process_count is None
                      else process_count)
+    if wire not in ("float32", "uint8"):
+        raise ValueError(f"wire must be 'float32' or 'uint8', got {wire!r}")
+    u8 = wire == "uint8"
 
     files = get_filenames(is_training, data_dir)
     if is_training and process_count > 1:
         files = shard_for_process(files, process_id, process_count) or files
-    images, labels = load_records(files)
+    # raw uint8 resident set (150 MB, not 600); the f32 wire casts at
+    # yield time, which reproduces the old all-f32 numerics exactly
+    # (pad/crop/flip are value-preserving)
+    images, labels = load_records(files, dtype=np.uint8)
     if is_training and len(images) < batch_size:
         raise ValueError(
             f"process {process_id}'s file shard holds {len(images)} images, "
             f"fewer than the per-host batch {batch_size}; reduce batch_size "
             f"or process count")
     rng = np.random.default_rng(seed + 7919 * process_id)
+
+    def finalize(batch: np.ndarray) -> np.ndarray:
+        if u8:
+            return batch
+        return standardize(batch.astype(np.float32))
 
     def gen():
         if is_training:
@@ -149,10 +174,10 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 for i in range(0, len(order) - batch_size + 1, batch_size):
                     idx = order[i:i + batch_size]
                     batch = augment_batch(images[idx], rng)
-                    yield standardize(batch), labels[idx]
+                    yield finalize(batch), labels[idx]
         elif drop_remainder:
             for i in range(0, len(images) - batch_size + 1, batch_size):
-                yield (standardize(images[i:i + batch_size].copy()),
+                yield (finalize(images[i:i + batch_size].copy()),
                        labels[i:i + batch_size])
         else:
             # exact full-coverage eval: each process takes the stride
@@ -166,11 +191,11 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
             for b in range(nbatches):
                 sel = local_idx[b * batch_size:(b + 1) * batch_size]
                 imgs = np.zeros((batch_size, HEIGHT, WIDTH, NUM_CHANNELS),
-                                np.float32)
+                                np.uint8 if u8 else np.float32)
                 lbls = np.zeros((batch_size,), np.int32)
                 mask = np.zeros((batch_size,), np.float32)
                 if len(sel):
-                    imgs[:len(sel)] = standardize(images[sel].copy())
+                    imgs[:len(sel)] = finalize(images[sel].copy())
                     lbls[:len(sel)] = labels[sel]
                     mask[:len(sel)] = 1.0
                 yield imgs, lbls, mask

@@ -105,6 +105,24 @@ def _resize_bilinear(image: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
         dtype=np.float32)
 
 
+def _round_u8(images: np.ndarray) -> np.ndarray:
+    """Round-half-up to the uint8 wire — the native StoreU8 policy
+    (floor(v + 0.5)); bilinear samples of uint8 sources stay in
+    [0, 255], the clip only guards fp drift."""
+    return np.clip(np.floor(images + 0.5), 0, 255).astype(np.uint8)
+
+
+def _meansub_to_u8(images: np.ndarray, ok: np.ndarray) -> np.ndarray:
+    """Reconstruct the uint8 wire from a mean-subtracted f32 batch
+    (stale-.so fallback: the native op only produced the f32 wire).
+    Only rows with ok=True are converted — failed rows of the np.empty
+    output hold uninitialized memory (possible NaN → numpy cast
+    warnings) and are patched by the caller's re-decode anyway."""
+    out = np.zeros(images.shape, np.uint8)
+    out[ok] = _round_u8(images[ok] + CHANNEL_MEANS)
+    return out
+
+
 def sample_distorted_bbox(rng: np.random.Generator, height: int, width: int,
                           bbox: Optional[np.ndarray],
                           min_object_covered: float = 0.1,
@@ -136,7 +154,8 @@ def sample_distorted_bbox(rng: np.random.Generator, height: int, width: int,
     return 0, 0, height, width
 
 
-def preprocess_train(buf: bytes, bbox, rng: np.random.Generator) -> np.ndarray:
+def preprocess_train(buf: bytes, bbox, rng: np.random.Generator,
+                     as_u8: bool = False) -> np.ndarray:
     nj = native_jpeg_module()
     if nj is not None:
         try:
@@ -159,20 +178,24 @@ def preprocess_train(buf: bytes, bbox, rng: np.random.Generator) -> np.ndarray:
         cropped = cropped[:, ::-1]
     out = _resize_bilinear(np.ascontiguousarray(cropped),
                            DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE)
-    return out - CHANNEL_MEANS
+    return _round_u8(out) if as_u8 else out - CHANNEL_MEANS
 
 
-def preprocess_eval(buf: bytes) -> np.ndarray:
+def preprocess_eval(buf: bytes, as_u8: bool = False) -> np.ndarray:
     """Aspect-preserving resize to shorter side RESIZE_MIN (:438-480) +
-    central crop (:375-394) + mean subtract.  Dispatches to the fused
-    native pass (decode window → one tf-bilinear sampling) when built;
-    Python/PIL fallback below."""
+    central crop (:375-394) + mean subtract (or the raw-pixel uint8
+    wire with ``as_u8``).  Dispatches to the fused native pass (decode
+    window → one tf-bilinear sampling) when built; Python/PIL fallback
+    below."""
     nj = native_jpeg_module()
     if nj is not None and hasattr(nj, "eval_batch"):
+        u8_native = as_u8 and nj.wire_u8_supported()
         out, ok = nj.eval_batch([buf], RESIZE_MIN, DEFAULT_IMAGE_SIZE,
                                 DEFAULT_IMAGE_SIZE, CHANNEL_MEANS,
-                                num_threads=1)
+                                num_threads=1, out_u8=u8_native)
         if ok[0]:
+            if as_u8 and not u8_native:  # stale-.so requantize (ok row)
+                return _round_u8(out[0] + CHANNEL_MEANS)
             return out[0]
     image = decode_jpeg(buf)
     h, w = image.shape[:2]
@@ -182,7 +205,7 @@ def preprocess_eval(buf: bytes) -> np.ndarray:
     oy = (nh - DEFAULT_IMAGE_SIZE) // 2
     ox = (nw - DEFAULT_IMAGE_SIZE) // 2
     crop = resized[oy:oy + DEFAULT_IMAGE_SIZE, ox:ox + DEFAULT_IMAGE_SIZE]
-    return crop - CHANNEL_MEANS
+    return _round_u8(crop) if as_u8 else crop - CHANNEL_MEANS
 
 
 def parse_example_record(raw: bytes):
@@ -235,9 +258,16 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                       drop_remainder: bool = True,
                       fast_dct: bool = False,
                       scaled_decode: bool = False,
-                      stats: Optional[dict] = None) -> Iterator:
-    """Yields (images float32 [B,224,224,3], labels int32 [B]) — plus a
+                      stats: Optional[dict] = None,
+                      wire: str = "float32") -> Iterator:
+    """Yields (images [B,224,224,3], labels int32 [B]) — plus a
     float32 validity mask [B] for eval with ``drop_remainder=False``.
+
+    ``wire``: host→device batch format.  ``"float32"`` = mean-subtracted
+    f32 (r1-r3 behavior); ``"uint8"`` = raw post-resize pixels rounded
+    half-up — 4x fewer bytes per batch (RUN_r03 measured the f32 wire
+    transfer-bound at 38 MB/batch) — with mean subtraction deferred to
+    the compiled step (data/normalize.py imagenet_mean_subtract).
 
     ``stats``: pass a dict to collect per-batch timing from the native
     train path — keys py_s (GIL-held Python work: Example parse, crop
@@ -260,6 +290,9 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     process_id = jax.process_index() if process_id is None else process_id
     process_count = (jax.process_count() if process_count is None
                      else process_count)
+    if wire not in ("float32", "uint8"):
+        raise ValueError(f"wire must be 'float32' or 'uint8', got {wire!r}")
+    u8 = wire == "uint8"
     files = get_filenames(is_training, data_dir)
     pad_eval = (not is_training) and (not drop_remainder)
     # drop-mode eval must yield the same batch count on every host or
@@ -298,6 +331,9 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     nj = native_jpeg_module()
     batch_native = (is_training and nj is not None
                     and hasattr(nj, "decode_crop_resize_batch"))
+    # uint8 straight out of the C++ ops when the library has the wire;
+    # a stale .so degrades to f32 + host requantize (_meansub_to_u8)
+    u8_native = u8 and nj is not None and nj.wire_u8_supported()
 
     def reader():
         # shuffle buffer over raw records (:114-120)
@@ -345,7 +381,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
             cropped = cropped[:, ::-1]
         out = _resize_bilinear(np.ascontiguousarray(cropped),
                                DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE)
-        return out - CHANNEL_MEANS
+        return _round_u8(out) if u8 else out - CHANNEL_MEANS
 
     # Fully-native batch path: parse + crop-sample + decode all happen
     # in ONE C++ call (dtf_train_example_batch) — the per-record Python
@@ -364,7 +400,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     def _python_record(raw, wrng):
         """Whole-record Python fallback (parse failures)."""
         buf, label, bbox = parse_example_record(raw)
-        return preprocess_train(buf, bbox, wrng), label
+        return preprocess_train(buf, bbox, wrng, as_u8=u8), label
 
     def batch_worker(wid: int):
         """One whole batch per iteration, end-to-end in C++ when the
@@ -397,7 +433,10 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                             chunk, batch_seed, DEFAULT_IMAGE_SIZE,
                             DEFAULT_IMAGE_SIZE, CHANNEL_MEANS,
                             num_threads=1, fast_dct=fast_dct,
-                            scaled_decode=scaled_decode)
+                            scaled_decode=scaled_decode,
+                            out_u8=u8_native)
+                    if u8 and not u8_native:
+                        images = _meansub_to_u8(images, statuses == 0)
                     t2 = _time.perf_counter()
                     for j in np.nonzero(statuses)[0]:
                         if statuses[j] == 1:  # parse/header failure
@@ -422,7 +461,8 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                         flips.append(bool(wrng.random() < 0.5))
                     except ValueError:
                         # undecodable header → whole-image Python path
-                        slow[len(bufs)] = preprocess_train(buf, bbox, wrng)
+                        slow[len(bufs)] = preprocess_train(buf, bbox, wrng,
+                                                       as_u8=u8)
                         crops.append((0, 0, 1, 1))
                         flips.append(False)
                     bufs.append(buf)
@@ -430,7 +470,10 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 images, ok = nj.decode_crop_resize_batch(
                     bufs, crops, flips, DEFAULT_IMAGE_SIZE,
                     DEFAULT_IMAGE_SIZE, CHANNEL_MEANS, num_threads=1,
-                    fast_dct=fast_dct, scaled_decode=scaled_decode)
+                    fast_dct=fast_dct, scaled_decode=scaled_decode,
+                    out_u8=u8_native)
+                if u8 and not u8_native:
+                    images = _meansub_to_u8(images, ok)
                 t2 = _time.perf_counter()
                 record_stats(t1 - t0, t2 - t1)
                 for j, img in slow.items():
@@ -454,8 +497,8 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                 return
             try:
                 buf, label, bbox = parse_example_record(raw)
-                img = (preprocess_train(buf, bbox, wrng) if is_training
-                       else preprocess_eval(buf))
+                img = (preprocess_train(buf, bbox, wrng, as_u8=u8)
+                       if is_training else preprocess_eval(buf, as_u8=u8))
                 out_q.put((img, label))
             except Exception as e:
                 out_q.put(e)
@@ -531,7 +574,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
 
     def gen():
         images = np.empty((batch_size, DEFAULT_IMAGE_SIZE, DEFAULT_IMAGE_SIZE,
-                           NUM_CHANNELS), np.float32)
+                           NUM_CHANNELS), np.uint8 if u8 else np.float32)
         labels = np.empty((batch_size,), np.int32)
         filled = 0
         done_workers = 0

@@ -52,16 +52,22 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int, u8p, ctypes.c_int]
     lib.dtf_jpeg_decode_batch.restype = ctypes.c_int
     f32p = ctypes.POINTER(ctypes.c_float)
+    # Libraries exporting dtf_wire_u8 take a void* output plus a
+    # trailing out_u8 selector on the fused batch ops (the uint8
+    # host→device wire); older builds keep the f32-only signatures.
+    u8_wire = hasattr(lib, "dtf_wire_u8")
+    outp = ctypes.c_void_p if u8_wire else f32p
+    tail = [ctypes.c_int] if u8_wire else []
     lib.dtf_jpeg_decode_crop_resize_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int, ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_int,
-        ctypes.c_int, f32p, f32p, u8p, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int]
+        ctypes.c_int, f32p, outp, u8p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int] + tail
     lib.dtf_jpeg_decode_crop_resize_batch.restype = ctypes.c_int
     lib.dtf_jpeg_eval_batch.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p,
-        f32p, u8p, ctypes.c_int, ctypes.c_int]
+        outp, u8p, ctypes.c_int, ctypes.c_int] + tail
     lib.dtf_jpeg_eval_batch.restype = ctypes.c_int
     if hasattr(lib, "dtf_train_example_batch"):
         i32p = ctypes.POINTER(ctypes.c_int32)
@@ -69,8 +75,8 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
             ctypes.c_uint64, ctypes.c_int, ctypes.c_int, f32p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, f32p, i32p, i32p,
-            u8p, u8p]
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, outp, i32p, i32p,
+            u8p, u8p] + tail
         lib.dtf_train_example_batch.restype = ctypes.c_int
     _lib = lib
     return _lib

@@ -255,26 +255,55 @@ int dtf_jpeg_decode_batch(const uint8_t** bufs, const int64_t* lens, int n,
   return failures.load();
 }
 
+}  // extern "C" — the templated sampler below needs C++ linkage
+
 // ---------------------------------------------------------------------------
-// Fused decode→crop→(flip)→bilinear-resize→mean-subtract batch — the
-// whole ImageNet train-time augmentation (imagenet_preprocessing.py
+// Fused decode→crop→(flip)→bilinear-resize→store batch — the whole
+// ImageNet train-time augmentation (imagenet_preprocessing.py
 // _decode_crop_and_flip + _resize_image + _mean_image_subtraction) per
 // image in one C++ pass, n images across num_threads threads, GIL-free.
 // Bilinear = half-pixel centers, no antialias (tf.image.resize v2).
-// Per-image variable crop windows; fixed [oh, ow] float32 output.
+// Per-image variable crop windows; fixed [oh, ow] output in one of two
+// wire formats (the Store policies below).
 // statuses[i] = 0 ok / 1 failed (caller re-decodes failures its own
 // way).  Returns the failure count.
 // ---------------------------------------------------------------------------
+
+// Output stores for the bilinear sampler.  StoreF32Sub: float32 with
+// per-channel mean subtraction — the host-normalized wire.  StoreU8:
+// round-half-up to uint8 (floorf(v + 0.5f), matching the Python
+// fallback's np.floor(v + 0.5)) with NO normalization — the TPU-native
+// wire: batches ship 4x fewer bytes host→device and the mean-subtract /
+// standardize runs as the first op inside the compiled step.  Bilinear
+// output of uint8 inputs is a convex combination in [0, 255]; the clamp
+// only guards fp drift.
+struct StoreF32Sub {
+  float* dst;
+  const float* sub;
+  inline void put(size_t idx, int ch, float v) const {
+    dst[idx] = v - sub[ch];
+  }
+};
+
+struct StoreU8 {
+  uint8_t* dst;
+  inline void put(size_t idx, int ch, float v) const {
+    (void)ch;
+    float r = floorf(v + 0.5f);
+    dst[idx] = static_cast<uint8_t>(r < 0.f ? 0.f : (r > 255.f ? 255.f : r));
+  }
+};
 
 // Generic bilinear sampler: output pixel (r, c) reads source position
 // (y_off + r*y_step, x_off + c*x_step), clamped — tf.image.resize v2
 // semantics when y_off = 0.5*y_step - 0.5 (plain resize), and the
 // aspect-preserving-resize + central-crop composition when the offsets
 // carry the crop origin.
-static void bilinear_sample_sub(const uint8_t* src, int sh, int sw,
-                                float* dst, int oh, int ow, int flip,
-                                float y_off, float y_step, float x_off,
-                                float x_step, const float* sub) {
+template <typename Store>
+static void bilinear_sample_store(const uint8_t* src, int sh, int sw,
+                                  int oh, int ow, int flip,
+                                  float y_off, float y_step, float x_off,
+                                  float x_step, const Store& st) {
   // column sampling tables, computed once (not per row)
   std::vector<int> xas(ow), xbs(ow);
   std::vector<float> wxs(ow);
@@ -296,17 +325,33 @@ static void bilinear_sample_sub(const uint8_t* src, int sh, int sw,
     int yb = y0 + 1 < 0 ? 0 : (y0 + 1 >= sh ? sh - 1 : y0 + 1);
     const uint8_t* rowa = src + static_cast<size_t>(ya) * sw * 3;
     const uint8_t* rowb = src + static_cast<size_t>(yb) * sw * 3;
-    float* out_row = dst + static_cast<size_t>(r) * ow * 3;
+    const size_t row_base = static_cast<size_t>(r) * ow * 3;
     for (int c = 0; c < ow; c++) {
       const int xa = xas[c], xb = xbs[c];
       const float wx = wxs[c];
       for (int ch = 0; ch < 3; ch++) {
         float top = (1.0f - wx) * rowa[xa + ch] + wx * rowa[xb + ch];
         float bot = (1.0f - wx) * rowb[xa + ch] + wx * rowb[xb + ch];
-        out_row[c * 3 + ch] =
-            (1.0f - wy) * top + wy * bot - sub[ch];
+        st.put(row_base + c * 3 + ch, ch, (1.0f - wy) * top + wy * bot);
       }
     }
+  }
+}
+
+// Dispatches the sampler on the wire format (out_u8 selects StoreU8).
+static void bilinear_sample_out(const uint8_t* src, int sh, int sw,
+                                void* dst, int out_u8, int oh, int ow,
+                                int flip, float y_off, float y_step,
+                                float x_off, float x_step,
+                                const float* sub) {
+  if (out_u8) {
+    bilinear_sample_store(src, sh, sw, oh, ow, flip, y_off, y_step,
+                          x_off, x_step,
+                          StoreU8{static_cast<uint8_t*>(dst)});
+  } else {
+    bilinear_sample_store(src, sh, sw, oh, ow, flip, y_off, y_step,
+                          x_off, x_step,
+                          StoreF32Sub{static_cast<float*>(dst), sub});
   }
 }
 
@@ -320,8 +365,9 @@ static void bilinear_sample_sub(const uint8_t* src, int sh, int sw,
 // images), while N<=4 wins 10-30%.  Returns 0 on success.
 static int decode_resize_one(const uint8_t* buf, int64_t len, int y, int x,
                              int ch, int cw, int flip, int oh, int ow,
-                             const float* sub, float* dst, int fast_dct,
-                             int scaled_decode, std::vector<uint8_t>& tmp) {
+                             const float* sub, void* dst, int out_u8,
+                             int fast_dct, int scaled_decode,
+                             std::vector<uint8_t>& tmp) {
   if (ch <= 0 || cw <= 0) return 1;
   int num = 8;
   if (scaled_decode) {
@@ -337,7 +383,7 @@ static int decode_resize_one(const uint8_t* buf, int64_t len, int y, int x,
     if (jpeg_decode_crop_impl(buf, len, y, x, ch, cw, tmp.data(),
                               fast_dct))
       return 1;
-    bilinear_sample_sub(tmp.data(), ch, cw, dst, oh, ow, flip,
+    bilinear_sample_out(tmp.data(), ch, cw, dst, out_u8, oh, ow, flip,
                         0.5f * ys - 0.5f, ys, 0.5f * xs - 0.5f, xs, sub);
   } else {
     // decode window in N/8-scaled coordinates covering the crop
@@ -351,17 +397,27 @@ static int decode_resize_one(const uint8_t* buf, int64_t len, int y, int x,
       return 1;
     // full-res source coord f sits at (f + 0.5)*s - 0.5 in scaled
     // space; carry the crop origin and window offset through
-    bilinear_sample_sub(tmp.data(), chs, cws, dst, oh, ow, flip,
+    bilinear_sample_out(tmp.data(), chs, cws, dst, out_u8, oh, ow, flip,
                         (y + 0.5f * ys) * s - 0.5f - y0s, ys * s,
                         (x + 0.5f * xs) * s - 0.5f - x0s, xs * s, sub);
   }
   return 0;
 }
 
+extern "C" {
+
+// Capability marker: a library exporting this symbol supports the
+// uint8 wire (trailing out_u8 parameter on the fused batch ops).  The
+// Python layer gates uint8 mode on it so a stale .so degrades to the
+// float32 wire instead of writing garbage.
+int dtf_wire_u8(void) { return 1; }
+
 int dtf_jpeg_decode_crop_resize_batch(
     const uint8_t** bufs, const int64_t* lens, int n, const int* crops,
-    const uint8_t* flips, int oh, int ow, const float* sub, float* out,
-    uint8_t* statuses, int num_threads, int fast_dct, int scaled_decode) {
+    const uint8_t* flips, int oh, int ow, const float* sub, void* out,
+    uint8_t* statuses, int num_threads, int fast_dct, int scaled_decode,
+    int out_u8) {
+  const size_t px = static_cast<size_t>(oh) * ow * 3;
   std::atomic<int> next(0), failures(0);
   auto work = [&]() {
     std::vector<uint8_t> tmp;
@@ -369,10 +425,12 @@ int dtf_jpeg_decode_crop_resize_batch(
       int i = next.fetch_add(1);
       if (i >= n) return;
       const int* c = crops + i * 4;
-      float* dst = out + static_cast<size_t>(i) * oh * ow * 3;
+      void* dst = out_u8
+          ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
+          : static_cast<void*>(static_cast<float*>(out) + i * px);
       if (decode_resize_one(bufs[i], lens[i], c[0], c[1], c[2], c[3],
                             flips ? flips[i] : 0, oh, ow, sub, dst,
-                            fast_dct, scaled_decode, tmp)) {
+                            out_u8, fast_dct, scaled_decode, tmp)) {
         statuses[i] = 1;
         failures.fetch_add(1);
         continue;
@@ -666,8 +724,9 @@ static void sample_distorted_bbox(Rng& rng, int height, int width,
 int dtf_train_example_batch(
     const uint8_t** recs, const int64_t* lens, int n, uint64_t seed,
     int oh, int ow, const float* sub, int fast_dct, int scaled_decode,
-    int num_threads, float* out, int32_t* labels, int32_t* crops,
-    uint8_t* flips, uint8_t* statuses) {
+    int num_threads, void* out, int32_t* labels, int32_t* crops,
+    uint8_t* flips, uint8_t* statuses, int out_u8) {
+  const size_t px = static_cast<size_t>(oh) * ow * 3;
   std::atomic<int> next(0), failures(0);
   auto work = [&]() {
     std::vector<uint8_t> tmp;
@@ -693,10 +752,12 @@ int dtf_train_example_batch(
       sample_distorted_bbox(rng, h, w, ex.bbox, ex.has_bbox, crop);
       const int flip = rng.uniform() < 0.5 ? 1 : 0;
       flips[i] = static_cast<uint8_t>(flip);
-      float* dst = out + static_cast<size_t>(i) * oh * ow * 3;
+      void* dst = out_u8
+          ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
+          : static_cast<void*>(static_cast<float*>(out) + i * px);
       if (decode_resize_one(ex.encoded, ex.encoded_len, crop[0], crop[1],
                             crop[2], crop[3], flip, oh, ow, sub, dst,
-                            fast_dct, scaled_decode, tmp)) {
+                            out_u8, fast_dct, scaled_decode, tmp)) {
         statuses[i] = 2;
         failures.fetch_add(1);
         continue;
@@ -724,8 +785,9 @@ int dtf_train_example_batch(
 
 int dtf_jpeg_eval_batch(const uint8_t** bufs, const int64_t* lens, int n,
                         int resize_min, int oh, int ow, const float* sub,
-                        float* out, uint8_t* statuses, int num_threads,
-                        int fast_dct) {
+                        void* out, uint8_t* statuses, int num_threads,
+                        int fast_dct, int out_u8) {
+  const size_t px = static_cast<size_t>(oh) * ow * 3;
   std::atomic<int> next(0), failures(0);
   auto work = [&]() {
     std::vector<uint8_t> tmp;
@@ -768,8 +830,10 @@ int dtf_jpeg_eval_batch(const uint8_t** bufs, const int64_t* lens, int n,
         failures.fetch_add(1);
         continue;
       }
-      bilinear_sample_sub(tmp.data(), wh, ww,
-                          out + static_cast<size_t>(i) * oh * ow * 3,
+      void* dst = out_u8
+          ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
+          : static_cast<void*>(static_cast<float*>(out) + i * px);
+      bilinear_sample_out(tmp.data(), wh, ww, dst, out_u8,
                           oh, ow, /*flip=*/0, y_off - y0, ys,
                           x_off - x0, xs, sub);
       statuses[i] = 0;

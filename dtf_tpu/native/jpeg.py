@@ -75,10 +75,33 @@ def decode_batch(bufs, crops, ch: int, cw: int,
     return out
 
 
+def _out_ptr(lib, out):
+    """Output pointer matching the declared argtype: void* on u8-wire
+    libraries, float* on older builds."""
+    if hasattr(lib, "dtf_wire_u8"):
+        return out.ctypes.data_as(ctypes.c_void_p)
+    return out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u8_tail(lib, out_u8: bool):
+    """Trailing out_u8 argument — only on libraries whose signature has
+    it (callers already raised if out_u8 was requested without it)."""
+    return (int(out_u8),) if hasattr(lib, "dtf_wire_u8") else ()
+
+
+def wire_u8_supported() -> bool:
+    """True when the built library supports the uint8 output wire
+    (the trailing ``out_u8`` parameter on the fused batch ops).  A
+    stale .so without the marker symbol degrades to the float32 wire."""
+    lib = load()
+    return lib is not None and hasattr(lib, "dtf_wire_u8")
+
+
 def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
                              sub, num_threads: int = 4,
                              fast_dct: bool = False,
-                             scaled_decode: bool = False):
+                             scaled_decode: bool = False,
+                             out_u8: bool = False):
     """The whole train-time augmentation for a batch in one C++ call:
     fused decode-and-crop (per-image variable windows) → horizontal
     flip → bilinear resize (half-pixel centers, tf.image.resize v2
@@ -99,13 +122,22 @@ def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
     downsampling filter chain, not the crop geometry; a throughput
     opt-in for large-image datasets, never a default.
 
-    Returns (float32 [n, out_h, out_w, 3], ok mask bool [n]); failed
-    images (rare decoder edge cases) have ok=False and undefined
+    ``out_u8``: uint8 output wire — pixels round-half-up post-resize,
+    NO mean subtraction (normalization moves into the compiled step on
+    the accelerator; 4x fewer host→device bytes).  Requires a library
+    with :func:`wire_u8_supported`.
+
+    Returns (float32|uint8 [n, out_h, out_w, 3], ok mask bool [n]);
+    failed images (rare decoder edge cases) have ok=False and undefined
     content — the caller re-decodes them however it likes.
     """
     lib = _lib()
+    if out_u8 and not hasattr(lib, "dtf_wire_u8"):
+        raise ImportError("libdtf_native.so predates the uint8 wire; "
+                          "rebuild (make -C dtf_tpu/native)")
     n = len(bufs)
-    out = np.empty((n, out_h, out_w, 3), np.float32)
+    out = np.empty((n, out_h, out_w, 3),
+                   np.uint8 if out_u8 else np.float32)
     statuses = np.empty((n,), np.uint8)
     buf_ptrs = (ctypes.c_char_p * n)(*bufs)
     lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
@@ -118,15 +150,16 @@ def decode_crop_resize_batch(bufs, crops, flips, out_h: int, out_w: int,
         flip_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         out_h, out_w,
         sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _out_ptr(lib, out),
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        num_threads, int(fast_dct), int(scaled_decode))
+        num_threads, int(fast_dct), int(scaled_decode), *_u8_tail(lib, out_u8))
     return out, statuses == 0
 
 
 def train_example_batch(records, seed: int, out_h: int, out_w: int, sub,
                         num_threads: int = 4, fast_dct: bool = False,
-                        scaled_decode: bool = False):
+                        scaled_decode: bool = False,
+                        out_u8: bool = False):
     """The whole train path for a batch of raw tf.train.Example
     records in one C++ call: proto parse (image/encoded, label, first
     bbox) → JPEG header → distorted-bbox sampling (reference
@@ -135,7 +168,10 @@ def train_example_batch(records, seed: int, out_h: int, out_w: int, sub,
     formerly GIL-held per-record Python work (the input pipeline's
     measured Amdahl serial fraction), off the interpreter.
 
-    Returns (images f32 [n,oh,ow,3], labels i32 [n] (shifted to
+    ``out_u8``: uint8 output wire (see
+    :func:`decode_crop_resize_batch`).
+
+    Returns (images f32|u8 [n,oh,ow,3], labels i32 [n] (shifted to
     [0,1000)), crops i32 [n,4], flips u8 [n], statuses u8 [n]):
     status 0 ok; 1 parse/header failure (reprocess the record in
     Python); 2 decode failure (re-decode with the returned crop/flip
@@ -145,8 +181,12 @@ def train_example_batch(records, seed: int, out_h: int, out_w: int, sub,
     if not hasattr(lib, "dtf_train_example_batch"):
         raise ImportError("libdtf_native.so predates "
                           "dtf_train_example_batch; rebuild")
+    if out_u8 and not hasattr(lib, "dtf_wire_u8"):
+        raise ImportError("libdtf_native.so predates the uint8 wire; "
+                          "rebuild (make -C dtf_tpu/native)")
     n = len(records)
-    out = np.empty((n, out_h, out_w, 3), np.float32)
+    out = np.empty((n, out_h, out_w, 3),
+                   np.uint8 if out_u8 else np.float32)
     labels = np.empty((n,), np.int32)
     crops = np.empty((n, 4), np.int32)
     flips = np.empty((n,), np.uint8)
@@ -159,16 +199,18 @@ def train_example_batch(records, seed: int, out_h: int, out_w: int, sub,
         out_h, out_w,
         sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         int(fast_dct), int(scaled_decode), num_threads,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _out_ptr(lib, out),
         labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         crops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        *_u8_tail(lib, out_u8))
     return out, labels, crops, flips, statuses
 
 
 def eval_batch(bufs, resize_min: int, out_h: int, out_w: int, sub,
-               num_threads: int = 4, fast_dct: bool = False):
+               num_threads: int = 4, fast_dct: bool = False,
+               out_u8: bool = False):
     """Fused eval preprocessing for a batch: aspect-preserving resize to
     shorter-side ``resize_min`` + central [out_h, out_w] crop +
     channel-mean subtraction in one sampling pass over a decode window
@@ -176,11 +218,18 @@ def eval_batch(bufs, resize_min: int, out_h: int, out_w: int, sub,
     numerics — the reference's eval path
     (imagenet_preprocessing.py:375-394,464-480).
 
-    Returns (float32 [n, out_h, out_w, 3], ok mask bool [n]).
+    ``out_u8``: uint8 output wire (see
+    :func:`decode_crop_resize_batch`).
+
+    Returns (float32|uint8 [n, out_h, out_w, 3], ok mask bool [n]).
     """
     lib = _lib()
+    if out_u8 and not hasattr(lib, "dtf_wire_u8"):
+        raise ImportError("libdtf_native.so predates the uint8 wire; "
+                          "rebuild (make -C dtf_tpu/native)")
     n = len(bufs)
-    out = np.empty((n, out_h, out_w, 3), np.float32)
+    out = np.empty((n, out_h, out_w, 3),
+                   np.uint8 if out_u8 else np.float32)
     statuses = np.empty((n,), np.uint8)
     buf_ptrs = (ctypes.c_char_p * n)(*bufs)
     lens = (ctypes.c_int64 * n)(*[len(b) for b in bufs])
@@ -188,7 +237,7 @@ def eval_batch(bufs, resize_min: int, out_h: int, out_w: int, sub,
     lib.dtf_jpeg_eval_batch(
         buf_ptrs, lens, n, resize_min, out_h, out_w,
         sub_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        _out_ptr(lib, out),
         statuses.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        num_threads, int(fast_dct))
+        num_threads, int(fast_dct), *_u8_tail(lib, out_u8))
     return out, statuses == 0

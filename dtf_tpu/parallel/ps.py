@@ -505,19 +505,30 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
         from dtf_tpu.data.cifar import cifar_input_fn
         train_iter = cifar_input_fn(cfg.data_dir, True, batch, seed=cfg.seed,
                                     process_id=worker_id,
-                                    process_count=num_workers)
-        eval_iter_fn = lambda: cifar_input_fn(cfg.data_dir, False, batch)
+                                    process_count=num_workers,
+                                    wire=cfg.input_wire)
+        eval_iter_fn = lambda: cifar_input_fn(cfg.data_dir, False, batch,
+                                              wire=cfg.input_wire)
     else:
         from dtf_tpu.data.imagenet import imagenet_input_fn
         train_iter = imagenet_input_fn(cfg.data_dir, True, batch,
                                        seed=cfg.seed, process_id=worker_id,
-                                       process_count=num_workers)
-        eval_iter_fn = lambda: imagenet_input_fn(cfg.data_dir, False, batch)
+                                       process_count=num_workers,
+                                       wire=cfg.input_wire)
+        eval_iter_fn = lambda: imagenet_input_fn(cfg.data_dir, False, batch,
+                                                 wire=cfg.input_wire)
+    # uint8 wire: normalization runs inside the jitted step (same
+    # single-source decision as the SPMD runner)
+    from dtf_tpu.data import normalize as normalize_lib
+    norm_fn = normalize_lib.for_config(cfg, spec)
 
     first_batch = next(train_iter)
     train_iter = itertools.chain([first_batch], train_iter)  # keep batch 0
+    init_images = jnp.asarray(first_batch[0][:1])
+    if norm_fn is not None:
+        init_images = norm_fn(init_images)
     variables = jax.jit(model.init, static_argnames=("train",))(
-        jax.random.key(cfg.seed), jnp.asarray(first_batch[0][:1]), train=False)
+        jax.random.key(cfg.seed), init_images, train=False)
     params0 = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     flat0, unravel = ravel_pytree(params0)
@@ -531,6 +542,8 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
 
     @jax.jit
     def step_fn(flat_params, batch_stats, images, labels):
+        if norm_fn is not None:
+            images = norm_fn(images)
         params = unravel(flat_params)
 
         def loss_fn(p):
@@ -554,6 +567,8 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
 
     @jax.jit
     def eval_fn(flat_params, batch_stats, images, labels):
+        if norm_fn is not None:
+            images = norm_fn(images)
         params = unravel(flat_params)
         variables = {"params": params}
         if has_bn:

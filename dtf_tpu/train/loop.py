@@ -164,12 +164,20 @@ class Trainer:
                  l2_weight: float, spec: DatasetSpec,
                  schedule: Optional[Callable] = None,
                  param_spec_fn: Optional[Callable] = None,
-                 vocab_axis: Optional[str] = None):
+                 vocab_axis: Optional[str] = None,
+                 normalize_fn: Optional[Callable] = None):
         self.cfg = cfg
         self.rt = runtime
         self.model = model
         self.l2_weight = l2_weight
         self.spec = spec
+        # uint8 wire: pipelines ship raw uint8 pixels and this runs as
+        # the FIRST op inside the compiled train/eval step (f32 math
+        # on-chip, fused by XLA into the first conv's input) — the
+        # TPU-native placement of the reference's in-graph
+        # normalization (imagenet_preprocessing.py:397-430).  None =
+        # host-normalized f32 wire.
+        self.normalize_fn = normalize_fn
         # vocab-sharded lm_head: logits arrive [B, S, V/mp] and the
         # loss/metrics go through the collective softmax forms
         self.vocab_axis = vocab_axis
@@ -261,6 +269,8 @@ class Trainer:
         images = jnp.asarray(sample_batch[0][:1])
         if self.channels_first:
             images = jnp.transpose(images, (0, 2, 3, 1))
+        if self.normalize_fn is not None:
+            images = self.normalize_fn(images)
         # a seq- or model-sharded module calls collectives and can only
         # run inside shard_map; param *shapes* don't depend on those
         # axes (TP shards arrive by sharding the full arrays), so init
@@ -509,10 +519,13 @@ class Trainer:
             return jnp.mean(compute_correct(logits, labels))
 
         accum = self.grad_accum
+        normalize = self.normalize_fn
 
         def local_train_step(state: TrainState, images, labels):
             if channels_first:
                 images = jnp.transpose(images, (0, 2, 3, 1))
+            if normalize is not None:
+                images = normalize(images)
             scale = state.loss_scale if dynamic else loss_scale
 
             def grad_of_chunk(params, batch_stats, imgs, lbls):
@@ -700,6 +713,8 @@ class Trainer:
             Units: examples for vision, tokens for sequence data."""
             if channels_first:
                 images = jnp.transpose(images, (0, 2, 3, 1))
+            if normalize is not None:
+                images = normalize(images)
             logits, _ = self._apply(state.params, state.batch_stats,
                                     images, train=False)
             per = compute_per_example_ce(logits, labels)  # [B] | [B,S/sp]

@@ -1,0 +1,244 @@
+"""uint8 host→device wire tests (VERDICT r3 #1).
+
+Pins the contract of the TPU-native wire: pipelines ship raw uint8
+pixels (4x fewer host→device bytes than the f32 wire) and the dataset
+normalization runs as the first op inside the compiled step
+(data/normalize.py).  Covered here:
+  - on-chip normalization matches host normalization of the SAME
+    uint8 pixels (bit-exact for the mean-subtract; float-association
+    tolerance for the standardize reductions)
+  - both wires of each pipeline see identical pixel values under the
+    same seed
+  - the native C++ u8 outputs are the exact round-half-up of the f32
+    outputs (StoreU8 vs StoreF32Sub over one bilinear sample)
+  - a Trainer consuming the uint8 wire reproduces the f32 wire's
+    training losses
+"""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from PIL import Image
+
+from dtf_tpu.data import cifar, imagenet, normalize, records
+
+
+# ---------------------------------------------------------------------------
+# on-chip normalize vs host normalize
+# ---------------------------------------------------------------------------
+
+def test_imagenet_onchip_meansub_bitexact():
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, (4, 16, 16, 3), np.uint8)
+    host = u8.astype(np.float32) - imagenet.CHANNEL_MEANS
+    chip = np.asarray(normalize.imagenet_mean_subtract(jnp.asarray(u8)))
+    # uint8→f32 is exact and the subtraction is elementwise: bit parity
+    np.testing.assert_array_equal(chip, host)
+
+
+def test_cifar_onchip_standardize_matches_host():
+    rng = np.random.default_rng(1)
+    u8 = rng.integers(0, 256, (4, 32, 32, 3), np.uint8)
+    host = cifar.standardize(u8.astype(np.float32))
+    chip = np.asarray(normalize.cifar_standardize(jnp.asarray(u8)))
+    # same f32 formula; the mean/std reductions may associate
+    # differently between numpy and XLA → tight tolerance, not bitwise
+    np.testing.assert_allclose(chip, host, rtol=1e-5, atol=1e-5)
+
+
+def test_cifar_onchip_standardize_constant_image():
+    chip = np.asarray(normalize.cifar_standardize(
+        jnp.full((1, 32, 32, 3), 7, jnp.uint8)))
+    assert np.isfinite(chip).all()
+    np.testing.assert_allclose(chip, 0.0, atol=1e-6)
+
+
+def test_for_dataset_mapping():
+    assert normalize.for_dataset("cifar10") is normalize.cifar_standardize
+    assert (normalize.for_dataset("imagenet")
+            is normalize.imagenet_mean_subtract)
+    with pytest.raises(ValueError):
+        normalize.for_dataset("lm")
+
+
+# ---------------------------------------------------------------------------
+# cifar pipeline: both wires see the same pixels
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cifar_dir(tmp_path):
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    for name, n in [("data_batch_1.bin", 24), ("data_batch_2.bin", 24),
+                    ("data_batch_3.bin", 24), ("data_batch_4.bin", 24),
+                    ("data_batch_5.bin", 24), ("test_batch.bin", 20)]:
+        recs = np.zeros((n, cifar.RECORD_BYTES), np.uint8)
+        recs[:, 0] = rng.integers(0, 10, n)
+        recs[:, 1:] = rng.integers(0, 256, (n, 3072))
+        (d / name).write_bytes(recs.tobytes())
+    return str(tmp_path)
+
+
+def test_cifar_wire_parity_train(cifar_dir):
+    kw = dict(is_training=True, batch_size=16, seed=11,
+              process_id=0, process_count=1)
+    u8_imgs, u8_lbls = next(cifar.cifar_input_fn(cifar_dir, wire="uint8",
+                                                 **kw))
+    f_imgs, f_lbls = next(cifar.cifar_input_fn(cifar_dir, wire="float32",
+                                               **kw))
+    assert u8_imgs.dtype == np.uint8
+    np.testing.assert_array_equal(u8_lbls, f_lbls)
+    # same seed → same augmentation → identical pixels; standardize is
+    # not bitwise-reproducible across differently-constructed equal
+    # arrays (numpy pairwise-sum blocking varies with buffer
+    # provenance, ~6e-8), hence allclose rather than array_equal
+    np.testing.assert_allclose(
+        cifar.standardize(u8_imgs.astype(np.float32)), f_imgs, atol=1e-6)
+
+
+def test_cifar_wire_parity_eval_padded(cifar_dir):
+    kw = dict(is_training=False, batch_size=8, process_id=0,
+              process_count=1, drop_remainder=False)
+    u8_batches = list(cifar.cifar_input_fn(cifar_dir, wire="uint8", **kw))
+    f_batches = list(cifar.cifar_input_fn(cifar_dir, wire="float32", **kw))
+    assert len(u8_batches) == len(f_batches)
+    for (ui, ul, um), (fi, fl, fm) in zip(u8_batches, f_batches):
+        assert ui.dtype == np.uint8
+        np.testing.assert_array_equal(ul, fl)
+        np.testing.assert_array_equal(um, fm)
+        real = um > 0
+        np.testing.assert_allclose(
+            cifar.standardize(ui[real].astype(np.float32)), fi[real],
+            atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# imagenet: native u8 outputs are the exact rounding of the f32 outputs
+# ---------------------------------------------------------------------------
+
+def _make_jpeg(rng, h=180, w=240):
+    arr = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+    return buf.getvalue()
+
+
+def _native_or_skip():
+    nj = imagenet.native_jpeg_module()
+    if nj is None or not nj.wire_u8_supported():
+        pytest.skip("native library with uint8 wire not built")
+    return nj
+
+
+def test_native_train_u8_is_rounded_f32():
+    nj = _native_or_skip()
+    rng = np.random.default_rng(4)
+    bufs = [_make_jpeg(rng) for _ in range(3)]
+    crops = [(10, 20, 150, 200), (0, 0, 180, 240), (5, 5, 100, 100)]
+    flips = [0, 1, 0]
+    sub = imagenet.CHANNEL_MEANS
+    f32, ok_f = nj.decode_crop_resize_batch(bufs, crops, flips, 224, 224,
+                                            sub, num_threads=1)
+    u8, ok_u = nj.decode_crop_resize_batch(bufs, crops, flips, 224, 224,
+                                           sub, num_threads=1, out_u8=True)
+    assert ok_f.all() and ok_u.all()
+    assert u8.dtype == np.uint8
+    # StoreU8 = floor(v + 0.5); StoreF32Sub = v - sub.  Compare in f64
+    # so adding the mean back does not re-round.
+    expect = np.floor(f32.astype(np.float64) + sub.astype(np.float64) + 0.5)
+    np.testing.assert_array_equal(u8.astype(np.float64), expect)
+
+
+def test_native_eval_u8_is_rounded_f32():
+    nj = _native_or_skip()
+    rng = np.random.default_rng(5)
+    bufs = [_make_jpeg(rng, 300, 260)]
+    sub = imagenet.CHANNEL_MEANS
+    f32, ok_f = nj.eval_batch(bufs, 256, 224, 224, sub, num_threads=1)
+    u8, ok_u = nj.eval_batch(bufs, 256, 224, 224, sub, num_threads=1,
+                             out_u8=True)
+    assert ok_f.all() and ok_u.all()
+    expect = np.floor(f32.astype(np.float64) + sub.astype(np.float64) + 0.5)
+    np.testing.assert_array_equal(u8.astype(np.float64), expect)
+
+
+# ---------------------------------------------------------------------------
+# imagenet pipeline e2e: u8 wire vs f32 wire under the same seed
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def imagenet_dir(tmp_path):
+    rng = np.random.default_rng(6)
+    for shard in range(2):
+        recs = []
+        for i in range(8):
+            recs.append(records.build_example({
+                "image/encoded": _make_jpeg(rng),
+                "image/class/label": [1 + (shard * 8 + i) % 1000],
+            }))
+        records.write_tfrecord_file(
+            str(tmp_path / f"train-{shard:05d}-of-01024"), recs)
+        records.write_tfrecord_file(
+            str(tmp_path / f"validation-{shard:05d}-of-00128"), recs)
+    return str(tmp_path)
+
+
+def test_imagenet_train_wire_parity(imagenet_dir):
+    kw = dict(is_training=True, batch_size=8, seed=13, num_threads=1,
+              process_id=0, process_count=1)
+    it_u8 = imagenet.imagenet_input_fn(imagenet_dir, wire="uint8", **kw)
+    it_f = imagenet.imagenet_input_fn(imagenet_dir, wire="float32", **kw)
+    u8_imgs, u8_lbls = next(it_u8)
+    f_imgs, f_lbls = next(it_f)
+    it_u8.close()
+    it_f.close()
+    assert u8_imgs.dtype == np.uint8 and u8_imgs.shape == (8, 224, 224, 3)
+    np.testing.assert_array_equal(u8_lbls, f_lbls)
+    # same seed ⇒ same crops/flips; the u8 wire is the rounded pixels,
+    # so after mean subtraction it sits within 0.5 of the f32 wire
+    diff = (u8_imgs.astype(np.float32) - imagenet.CHANNEL_MEANS) - f_imgs
+    assert np.abs(diff).max() <= 0.5 + 1e-3
+
+
+def test_imagenet_eval_wire_parity(imagenet_dir):
+    kw = dict(is_training=False, batch_size=8, num_threads=1,
+              process_id=0, process_count=1, drop_remainder=False)
+    u8_batches = list(imagenet.imagenet_input_fn(imagenet_dir,
+                                                 wire="uint8", **kw))
+    f_batches = list(imagenet.imagenet_input_fn(imagenet_dir,
+                                                wire="float32", **kw))
+    assert len(u8_batches) == len(f_batches) == 2
+    for (ui, ul, um), (fi, fl, fm) in zip(u8_batches, f_batches):
+        assert ui.dtype == np.uint8
+        np.testing.assert_array_equal(um, fm)
+        real = um > 0
+        diff = (ui[real].astype(np.float32)
+                - imagenet.CHANNEL_MEANS) - fi[real]
+        assert np.abs(diff).max() <= 0.5 + 1e-3
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: training over the u8 wire reproduces the f32 wire
+# ---------------------------------------------------------------------------
+
+def test_trainer_u8_wire_matches_f32(cifar_dir, monkeypatch):
+    import dataclasses
+    import dtf_tpu.data.base as data_base
+    from dtf_tpu.cli import run
+    from dtf_tpu.config import Config
+
+    spec = dataclasses.replace(data_base.CIFAR10, num_train=120,
+                               num_eval=20)
+    monkeypatch.setitem(data_base._SPECS, "cifar10", spec)
+    common = dict(model="resnet20", dataset="cifar10", data_dir=cifar_dir,
+                  batch_size=32, train_epochs=1, skip_eval=True,
+                  skip_checkpoint=True, verbose=0, log_steps=1,
+                  distribution_strategy="off")
+    loss_u8 = run(Config(**common, input_wire="uint8"))["loss"]
+    loss_f = run(Config(**common, input_wire="float32"))["loss"]
+    # identical pixels + identical init seed; only the standardize
+    # reduction association differs (host numpy vs on-chip XLA)
+    np.testing.assert_allclose(loss_u8, loss_f, rtol=2e-4)
