@@ -68,9 +68,15 @@ def make_shards(root: str, num_shards: int = NUM_SHARDS,
             os.path.join(root, f"train-{shard:05d}-of-01024"), recs)
 
 
-def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
+def measure(fast_dct: bool = False, scaled_decode: bool = False,
+            wire: str = "uint8") -> dict:
     """Runs the pipeline measurement and returns the JSON-able dict
-    (shared by the CLI below and bench.py's combined report)."""
+    (shared by the CLI below and bench.py's combined report).
+
+    ``wire`` defaults to uint8 — the production default
+    (Config.input_wire): the number this prints is the pipeline
+    configuration real runs use.  Pass "float32" for the r1-r3 wire.
+    """
     from dtf_tpu.data.imagenet import imagenet_input_fn, native_jpeg_module
 
     stats: dict = {}
@@ -79,7 +85,8 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
         batch = 64
         it = imagenet_input_fn(root, True, batch, seed=0, process_id=0,
                                process_count=1, fast_dct=fast_dct,
-                               scaled_decode=scaled_decode, stats=stats)
+                               scaled_decode=scaled_decode, stats=stats,
+                               wire=wire)
         # warmup: first batches pay thread spin-up + shuffle-buffer fill.
         # Snapshot-and-subtract instead of clear(), under the writers'
         # lock (published by the pipeline in stats["lock"]) so the
@@ -124,6 +131,7 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
         "cores": cores,
         "per_core": round(per_core, 1),
         "native_batch_decode": native_jpeg_module() is not None,
+        "wire": wire,
         "fast_dct": fast_dct,
         "scaled_decode": scaled_decode,
         "serial_fraction": (round(serial_fraction, 4)
@@ -137,8 +145,10 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False) -> dict:
 
 def main():
     import sys
+    wire = "float32" if "--wire_f32" in sys.argv else "uint8"
     print(json.dumps(measure(fast_dct="--fast_dct" in sys.argv,
-                             scaled_decode="--scaled_decode" in sys.argv)))
+                             scaled_decode="--scaled_decode" in sys.argv,
+                             wire=wire)))
 
 
 if __name__ == "__main__":

@@ -208,6 +208,87 @@ def flash_bench(seq: int = 8192, warmup: int = 3, iters: int = 10):
                 seq=seq, shape=list(shape))
 
 
+def _loop_time(body, init, n1: int = 16, n2: int = 144, reps: int = 5):
+    """Per-op seconds via a compiled fori_loop at two lengths:
+    (t(n2) - t(n1)) / (n2 - n1) cancels the tunnel's ~100 ms dispatch
+    floor, and min-over-reps suppresses its heavy-tailed jitter (both
+    made single-dispatch micro-timings unusable — see _flash timed()).
+    """
+    from jax import lax
+    ts = {}
+    for n in (n1, n2):
+        f = jax.jit(lambda x: lax.fori_loop(0, n, body, x))
+        f(init)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = f(init)
+            jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+            best = min(best, time.perf_counter() - t0)
+        ts[n] = best
+    return (ts[n2] - ts[n1]) / (n2 - n1)
+
+
+def dhead_bench(batch: int = 16, seq: int = SEQ):
+    """The d_head-64 penalty, measured at the flagship step shapes —
+    and WHY it is intrinsic to the MXU, not a kernel deficiency.
+
+    Two facts this prints (TPU v5 lite, bf16):
+      1. matmul passes bill ceil(d/128) MXU passes per 128x128 output
+         tile, and a 64-deep pass still costs ~0.6-0.75 of a 128-deep
+         one (mm64_ms vs mm128_ms: [8192,d]x[d,8192]).  So two d=64
+         score/PV matmuls always cost >= one d=128 matmul of equal
+         model FLOPs, and any "pack two 64-heads per 128-lane tile"
+         construction (block-diagonal operands, sum/difference tricks)
+         doubles output tiles or contraction passes and cancels out —
+         output_tiles x ceil(contraction/128) is conserved.
+      2. 12x64 attention also computes 2x the softmax score elements
+         of 6x128 (12*S^2 vs 6*S^2) — the VPU work doubles with head
+         count no matter how heads are packed.
+    Hence flash f+b at [16,2048,12,64] runs ~2.1x [16,2048,6,128]
+    (fwd64_ms etc. below) at identical parameter count, and the
+    TPU-native fix is the 6x128 layout itself (models/registry.py
+    transformer_tpu — the flagship default), not a kernel change.
+    """
+    from dtf_tpu.ops.flash_attention import flash_attention
+
+    key = jax.random.key(0)
+    out = {"metric": "dhead_attention_penalty", "unit": "ms",
+           "batch": batch, "seq": seq}
+    for h, d in ((6, 128), (12, 64)):
+        q = jax.random.normal(key, (batch, seq, h, d), jnp.bfloat16)
+        k = jax.random.normal(key, (batch, seq, h, d), jnp.bfloat16)
+        v = jax.random.normal(key, (batch, seq, h, d), jnp.bfloat16)
+        fwd = _loop_time(
+            lambda i, o: flash_attention(o, k, v, causal=True), q)
+
+        def fb(i, qq):
+            g = jax.grad(lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True).astype(jnp.float32)),
+                argnums=(0, 1, 2))(qq, k, v)
+            return (g[0] + g[1] + g[2]).astype(jnp.bfloat16)
+        fwdbwd = _loop_time(fb, q)
+        out[f"fwd{d}_ms"] = round(fwd * 1e3, 3)
+        out[f"fwdbwd{d}_ms"] = round(fwdbwd * 1e3, 3)
+    out["fwdbwd_penalty_x"] = round(out["fwdbwd64_ms"]
+                                    / out["fwdbwd128_ms"], 2)
+    n = 8192
+    for d in (64, 128):
+        a = jax.random.normal(key, (n, d), jnp.bfloat16)
+        b = jax.random.normal(key, (d, n), jnp.bfloat16)
+
+        def mm(i, a):
+            s = jnp.dot(a, b, preferred_element_type=jnp.float32)
+            # consume every element so XLA cannot slice away columns
+            return a + jnp.sum(s, axis=1)[:, None].astype(jnp.bfloat16) * 1e-9
+        # ~0.1 ms/op: needs a much wider loop span than the ~ms flash
+        # timings for the tunnel-jitter subtraction to resolve it
+        out[f"mm{d}_ms"] = round(
+            _loop_time(mm, a, n1=64, n2=1088) * 1e3, 4)
+    out["mm_depth64_cost_of_128"] = round(out["mm64_ms"] / out["mm128_ms"], 2)
+    return out
+
+
 def _gpipe_trainer(pp: int, m: int, interleave: int, remat: bool,
                    mesh, batch: int, seq: int, vocab: int):
     import functools
@@ -320,7 +401,7 @@ def main():
         variant = sys.argv[sys.argv.index("--variant") + 1]
     remat = "--remat" in sys.argv
     usage = ("usage: bench_lm.py [--seq N] [--heads N] [--remat] "
-             "[--variant flash|gpipe|gpipe_mem]")
+             "[--variant flash|gpipe|gpipe_mem|dhead]")
 
     def int_flag(name, default):
         if name not in sys.argv:
@@ -362,6 +443,14 @@ def main():
             "interleave_speedup_at_m_high": round(
                 r["interleave_speedup_at_m_high"], 2),
             "backend": jax.default_backend(),
+        }))
+        return
+    if variant == "dhead":
+        r = dhead_bench()
+        print(json.dumps({
+            **r, "value": r["fwdbwd_penalty_x"],
+            "vs_baseline": None,
+            "device_kind": jax.devices()[0].device_kind,
         }))
         return
     if variant == "gpipe_mem":

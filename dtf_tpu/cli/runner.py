@@ -88,16 +88,6 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
     return fns
 
 
-def deferred_normalize_fn(cfg: Config, spec: DatasetSpec):
-    """The compiled-step normalization matching make_input_fns' wire:
-    under the uint8 wire the real-data pipelines ship raw pixels and
-    the Trainer normalizes on-chip; single-sourced in
-    data/normalize.py for_config so the SPMD and async-PS paths cannot
-    disagree."""
-    from dtf_tpu.data import normalize
-    return normalize.for_config(cfg, spec)
-
-
 def _channels_first_factory(fn):
     import numpy as np
 
@@ -228,9 +218,13 @@ def run(cfg: Config) -> dict:
         from dtf_tpu.models.pipeline_lm import pipeline_param_partition_specs
         param_spec_fn = functools.partial(pipeline_param_partition_specs,
                                           pipe_axis=pipe_axis)
+    # uint8 wire: normalization runs inside the compiled step; the
+    # wire→normalize decision is single-sourced in for_config (the
+    # async-PS path calls the same function)
+    from dtf_tpu.data.normalize import for_config
     trainer = Trainer(cfg, rt, model, l2, spec, param_spec_fn=param_spec_fn,
                       vocab_axis=MODEL_AXIS if shard_vocab else None,
-                      normalize_fn=deferred_normalize_fn(cfg, spec))
+                      normalize_fn=for_config(cfg, spec))
     train_fn, eval_fn = make_input_fns(cfg, spec, global_batch)
 
     train_iter = train_fn()

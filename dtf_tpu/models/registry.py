@@ -41,10 +41,15 @@ _REGISTRY = {
         32_768, 0.0),
     # GPT-2-small-sized flagship with the TPU-native head layout:
     # 6 heads × d_head 128 instead of GPT-2's 12 × 64 — identical
-    # parameter shapes and count (768 = 12·64 = 6·128), but the MXU
-    # contracts/writes 128-wide attention tiles at full rate where
-    # 64-wide tiles run at half rate (measured: 22.4 → 39.3 TFLOP/s
-    # in-graph attention; +33% end-to-end tokens/s, bench_lm.py)
+    # parameter shapes and count (768 = 12·64 = 6·128).  The 12×64
+    # penalty is intrinsic MXU geometry, not a kernel gap: matmuls
+    # bill output_tiles × ceil(d/128) full passes (a 64-deep matmul
+    # measures 0.7-1.3× the wall time of the 128-deep one at half the
+    # FLOPs), so head-packing constructions cancel exactly, and 12
+    # heads compute 2× the softmax score elements.  Measured: flash
+    # f+b 5.7 vs 11.8 ms at the flagship shapes — 2.1×, +33%
+    # end-to-end tokens/s for this layout (bench_lm.py --variant
+    # dhead holds the reproducible probe)
     "transformer_tpu": (
         functools.partial(transformer.TransformerLM, num_layers=12,
                           d_model=768, num_heads=6, d_ff=3072),

@@ -412,6 +412,13 @@ extern "C" {
 // float32 wire instead of writing garbage.
 int dtf_wire_u8(void) { return 1; }
 
+// Per-image destination in the wire's element stride (px = oh*ow*3).
+static inline void* dst_at(void* out, int out_u8, int i, size_t px) {
+  return out_u8
+      ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
+      : static_cast<void*>(static_cast<float*>(out) + i * px);
+}
+
 int dtf_jpeg_decode_crop_resize_batch(
     const uint8_t** bufs, const int64_t* lens, int n, const int* crops,
     const uint8_t* flips, int oh, int ow, const float* sub, void* out,
@@ -425,9 +432,7 @@ int dtf_jpeg_decode_crop_resize_batch(
       int i = next.fetch_add(1);
       if (i >= n) return;
       const int* c = crops + i * 4;
-      void* dst = out_u8
-          ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
-          : static_cast<void*>(static_cast<float*>(out) + i * px);
+      void* dst = dst_at(out, out_u8, i, px);
       if (decode_resize_one(bufs[i], lens[i], c[0], c[1], c[2], c[3],
                             flips ? flips[i] : 0, oh, ow, sub, dst,
                             out_u8, fast_dct, scaled_decode, tmp)) {
@@ -752,9 +757,7 @@ int dtf_train_example_batch(
       sample_distorted_bbox(rng, h, w, ex.bbox, ex.has_bbox, crop);
       const int flip = rng.uniform() < 0.5 ? 1 : 0;
       flips[i] = static_cast<uint8_t>(flip);
-      void* dst = out_u8
-          ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
-          : static_cast<void*>(static_cast<float*>(out) + i * px);
+      void* dst = dst_at(out, out_u8, i, px);
       if (decode_resize_one(ex.encoded, ex.encoded_len, crop[0], crop[1],
                             crop[2], crop[3], flip, oh, ow, sub, dst,
                             out_u8, fast_dct, scaled_decode, tmp)) {
@@ -830,9 +833,7 @@ int dtf_jpeg_eval_batch(const uint8_t** bufs, const int64_t* lens, int n,
         failures.fetch_add(1);
         continue;
       }
-      void* dst = out_u8
-          ? static_cast<void*>(static_cast<uint8_t*>(out) + i * px)
-          : static_cast<void*>(static_cast<float*>(out) + i * px);
+      void* dst = dst_at(out, out_u8, i, px);
       bilinear_sample_out(tmp.data(), wh, ww, dst, out_u8,
                           oh, ow, /*flip=*/0, y_off - y0, ys,
                           x_off - x0, xs, sub);
