@@ -78,19 +78,30 @@ def conv_table(hlo_text: str):
         out_elems = int(np.prod(out))
         # out channels: HWIO kernels put O last and NHWC outputs put C
         # last — prefer that match (the largest-dim heuristic alone can
-        # grab the batch dim, e.g. in_channels 256 vs batch 256)
-        if kernel[1][-1] == out[-1]:
-            out_ch = kernel[1][-1]
+        # grab the batch dim, e.g. in_channels 256 vs batch 256).
+        # Dots lowered to 1x1 convs (the LM roofline) carry trailing
+        # size-1 window dims that would satisfy the last==last test
+        # with out_ch=1 — strip them first.
+        k_dims = list(kernel[1])
+        o_dims = list(out)
+        while k_dims and k_dims[-1] == 1:
+            k_dims.pop()
+        while o_dims and o_dims[-1] == 1:
+            o_dims.pop()
+        if not k_dims or not o_dims:
+            continue
+        if k_dims[-1] == o_dims[-1]:
+            out_ch = k_dims[-1]
         else:
-            out_ch = next((d for d in sorted(kernel[1], reverse=True)
-                           if d in out), None)
+            out_ch = next((d for d in sorted(k_dims, reverse=True)
+                           if d in o_dims), None)
         if not out_ch:
             continue
         flops = 2.0 * out_elems * (k_elems / out_ch)
         bpe = 2 if out_dt == "bf16" else 4
         bytes_min = bpe * (out_elems + k_elems + int(np.prod(act[1])))
         name = _OPNAME.search(line)
-        rows.append(dict(out=out, kernel=kernel[1], flops=flops,
+        rows.append(dict(out=out, kernel=kernel[1], act=act[1], flops=flops,
                          bytes_min=bytes_min,
                          name=name.group(1) if name else ""))
     return rows
