@@ -133,38 +133,34 @@ def main():
     ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
     hlo = compiled.as_text()
 
-    def timed(fn, *args, iters=20, warmup=5):
-        out = None
-        for _ in range(warmup):
-            out = fn(*args)
-        jax.device_get(jax.tree_util.tree_leaves(out)[0])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.device_get(jax.tree_util.tree_leaves(out)[0])
-        return (time.perf_counter() - t0) / iters
-
-    # full step — the state argument is donated, so thread it through
-    def run_steps(n):
-        nonlocal state
-        for _ in range(n):
-            state, metrics = trainer.train_step(state, *sharded)
-        return metrics
-
-    m = run_steps(5)
+    # full step — sync-cancelling windows (bench.timed_train_steps: a
+    # plain timed window bakes the ~105 ms tunnel sync into the time)
+    from bench import timed_train_steps
+    for _ in range(5):
+        state, m = trainer.train_step(state, *sharded)
     jax.device_get(m["loss"])
-    t0 = time.perf_counter()
-    m = run_steps(20)
-    jax.device_get(m["loss"])
-    step_s = (time.perf_counter() - t0) / 20
+    step_s, _, _, _, state = timed_train_steps(
+        trainer.train_step, state, sharded)
 
-    # fwd-only (loss value, no grad)
+    # fwd-only (loss value, no grad) — same sync-cancelling protocol
+    # as the full step so the fwd/bwd split is internally consistent
+    from bench import windowed_step_seconds
+
     def fwd_only(params, bstats, images, labels):
         logits, _, _ = trainer._apply(params, bstats, images, True)
         return jnp.mean(logits.astype(jnp.float32))
 
     fwd_jit = jax.jit(fwd_only)
-    fwd_s = timed(fwd_jit, state.params, state.batch_stats, *sharded)
+    obox = {}
+
+    def run_fwd(n):
+        for _ in range(n):
+            obox["o"] = fwd_jit(state.params, state.batch_stats, *sharded)
+
+    run_fwd(5)
+    jax.device_get(obox["o"])
+    fwd_s, _, _ = windowed_step_seconds(
+        run_fwd, lambda: jax.device_get(obox["o"]))
 
     device = jax.devices()[0]
     peak = peak_tflops(device) or 0.0

@@ -62,21 +62,18 @@ def build_step(report_acc: bool):
     return trainer, state, sharded
 
 
-def step_time(step_fn, state, sharded, warmup=3, iters=10, reps=3):
+def step_time(step_fn, state, sharded, warmup=3):
     """``step_fn``: the jitted trainer.train_step OR the AOT-compiled
     executable (reusing the AOT object avoids a second multi-minute
-    compile of the same 137M-param graph on this host)."""
+    compile of the same 137M-param graph on this host).  Timing via
+    bench.timed_train_steps (sync-cancelling)."""
+    from bench import timed_train_steps
     for _ in range(warmup):
         state, m = step_fn(state, *sharded)
     jax.device_get(m["loss"])
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, m = step_fn(state, *sharded)
-        jax.device_get(m["loss"])
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best, state
+    med, _, _, _, state = timed_train_steps(step_fn, state, sharded,
+                                            short=3, long=13)
+    return med, state
 
 
 def isolated_attention():
