@@ -78,6 +78,12 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_int, outp, i32p, i32p,
             u8p, u8p] + tail
         lib.dtf_train_example_batch.restype = ctypes.c_int
+    if hasattr(lib, "dtf_f32_to_bf16"):
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.dtf_f32_to_bf16.argtypes = [f32p, u16p, ctypes.c_int64]
+        lib.dtf_f32_to_bf16.restype = None
+        lib.dtf_bf16_to_f32.argtypes = [u16p, f32p, ctypes.c_int64]
+        lib.dtf_bf16_to_f32.restype = None
     _lib = lib
     return _lib
 

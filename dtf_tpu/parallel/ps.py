@@ -45,8 +45,22 @@ def _f32_to_bf16_bytes(a: np.ndarray) -> bytes:
     """Round-to-nearest-even f32 -> bf16, as raw u16 little-endian.
     NaNs are preserved explicitly (truncate + force the quiet bit) —
     the RNE add can carry a low-mantissa NaN payload into Inf or even
-    wrap to zero, silently masking a diverged gradient."""
-    u = np.ascontiguousarray(a, np.float32).view(np.uint32)
+    wrap to zero, silently masking a diverged gradient.
+
+    Dispatches to the native one-pass conversion when built (VERDICT
+    r3 #6: the numpy form's full-array temporaries under the GIL cost
+    more than the loopback wire saved); the numpy fallback is
+    bit-identical (tests/test_ps.py pins it)."""
+    a = np.ascontiguousarray(a, np.float32)
+    lib = native_lib.load()
+    if lib is not None and hasattr(lib, "dtf_f32_to_bf16"):
+        out = np.empty(a.shape, np.uint16)
+        lib.dtf_f32_to_bf16(
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            a.size)
+        return out.tobytes()
+    u = a.view(np.uint32)
     r = ((u + np.uint32(0x7FFF) + ((u >> np.uint32(16)) & np.uint32(1)))
          >> np.uint32(16)).astype(np.uint16)
     is_nan = ((u & np.uint32(0x7F800000)) == np.uint32(0x7F800000)) \
@@ -57,6 +71,15 @@ def _f32_to_bf16_bytes(a: np.ndarray) -> bytes:
 
 
 def _bf16_bytes_to_f32(b: bytes) -> np.ndarray:
+    lib = native_lib.load()
+    if lib is not None and hasattr(lib, "dtf_bf16_to_f32"):
+        src = np.frombuffer(b, np.uint16)
+        out = np.empty(src.shape, np.float32)
+        lib.dtf_bf16_to_f32(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            src.size)
+        return out
     u = np.frombuffer(b, np.uint16).astype(np.uint32) << np.uint32(16)
     return u.view(np.float32)
 

@@ -102,6 +102,32 @@ def test_bf16_conversion_matches_numpy():
     assert np.isnan(out).all()
 
 
+def test_bf16_conversion_native_matches_python_fallback(monkeypatch):
+    """The C one-pass conversion (VERDICT r3 #6) is bit-identical to
+    the numpy fallback, NaN payloads included.  The oracle is the
+    module's OWN fallback branch (native lookup forced to None), so a
+    future edit to either implementation breaks this test rather than
+    silently diverging wire bits between native and numpy-only hosts."""
+    lib = native_lib.load()
+    if lib is None or not hasattr(lib, "dtf_f32_to_bf16"):
+        pytest.skip("native bf16 conversion not built")
+    rng = np.random.default_rng(1)
+    x = np.concatenate([
+        rng.normal(0, 100, 100_000).astype(np.float32),
+        np.asarray([0.0, -0.0, 1e-40, -1e38, np.inf, -np.inf],
+                   np.float32),
+        np.asarray([0x7F800001, 0xFFFFFFFF, 0x7FC00000, 0xFFC00000],
+                   np.uint32).view(np.float32)])
+    native_push = ps_lib._f32_to_bf16_bytes(x)
+    monkeypatch.setattr(ps_lib.native_lib, "load", lambda: None)
+    fallback_push = ps_lib._f32_to_bf16_bytes(x)
+    assert native_push == fallback_push
+    fallback_pull = ps_lib._bf16_bytes_to_f32(fallback_push)
+    monkeypatch.undo()
+    native_pull = ps_lib._bf16_bytes_to_f32(native_push)
+    np.testing.assert_array_equal(native_pull, fallback_pull)
+
+
 def test_async_e2e_bf16_wire():
     """Single-process async demo trains with --ps_wire bf16."""
     from dtf_tpu.config import Config
