@@ -15,14 +15,15 @@ output tile, no atomics), recomputing probabilities per tile from the
 saved log-sum-exp (the standard flash trade: extra FLOPs for O(S²)
 less HBM traffic).  `_blockwise_bwd` (plain JAX, same math) remains as
 the portable oracle the kernels are tested against.  Measured on one
-TPU v5 lite chip, [2, 8192, 8, 128] bf16 causal: fwd 10.2 ms,
-backward-only 7.2 ms — 0.70× the forward (bench_lm.py --variant
-flash).  All three kernels stream K/V (or Q/dO) through VMEM one block
-per sequential grid step — carries live in VMEM scratch (fwd) or
-revisited output tiles (dq, dk/dv) — so VMEM stays capped at the block
-size regardless of sequence length: seq 32k compiles and runs (fwd
-33 ms at [1, 32768, 4, 128]) where a resident-K/V formulation exceeds
-scoped VMEM from seq 8k.
+TPU v5 lite chip, [2, 8192, 8, 128] bf16 causal (r4 sync-cancelled
+protocol): fwd 2.46 ms, backward-only 7.76 ms (bench_lm.py --variant
+flash; bwd does 2.5× the forward's FLOPs).  All three kernels stream
+K/V (or Q/dO) through VMEM one block per sequential grid step —
+carries live in VMEM scratch (fwd) or revisited output tiles (dq,
+dk/dv) — so VMEM stays capped at the block size regardless of
+sequence length: seq 32k compiles and runs (fwd 7.2 ms at
+[1, 32768, 4, 128]) where a resident-K/V formulation exceeds scoped
+VMEM from seq 8k.
 
 Causal masking is diagonal-only: blocks the diagonal never crosses run
 a mask-free accumulate (no iota/compare/select per element), and only
