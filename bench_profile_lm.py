@@ -129,6 +129,44 @@ def isolated_head_ce(chunk_rows=None):
     return _loop_time(fb, x)
 
 
+def isolated_embed_ln():
+    """Embed f+b (the bwd is a scatter-add into the [32k, 768] table —
+    a suspected TPU sink, measured negligible) and one LayerNorm f+b
+    (×24 in the step).  Closes the decomposition's remainder."""
+    import flax.linen as nn
+    key = jax.random.key(0)
+    tokens = jax.random.randint(key, (BATCH, SEQ), 0, VOCAB)
+    emb = nn.Embed(VOCAB, D_MODEL, dtype=jnp.bfloat16)
+    eparams = emb.init(key, tokens)
+
+    def eloss(p, tokens):
+        return jnp.sum(emb.apply(p, tokens).astype(jnp.float32) ** 2)
+
+    def efb(i, carry):
+        # params depend on the carry so the grad is loop-VARIANT —
+        # XLA hoists loop-invariant computations out of fori_loop and
+        # the differenced timing would measure a scalar add
+        p = jax.tree_util.tree_map(
+            lambda l: l + carry.astype(l.dtype), eparams)
+        g = jax.tree_util.tree_leaves(jax.grad(eloss)(p, tokens))[0]
+        return carry + jnp.sum(g).astype(jnp.float32) * 1e-20
+
+    embed_s = _loop_time(efb, jnp.float32(0.0))
+
+    x = jax.random.normal(key, (BATCH * SEQ, D_MODEL), jnp.bfloat16)
+    ln = nn.LayerNorm(dtype=jnp.bfloat16)
+    lp = ln.init(key, x)
+
+    def lnfb(i, xx):
+        g = jax.grad(lambda p, x: jnp.sum(
+            ln.apply(p, x).astype(jnp.float32) ** 2), argnums=1)(lp, xx)
+        # 1e-30, not 0: mul-by-zero would let XLA DCE the backward
+        return xx + g.astype(jnp.bfloat16) * jnp.bfloat16(1e-30)
+
+    ln_s = _loop_time(lnfb, x, n1=8, n2=136)
+    return embed_s, ln_s
+
+
 def main():
     device = jax.devices()[0]
     peak = peak_tflops(device) or 0.0
@@ -187,6 +225,7 @@ def main():
     attn_fb = isolated_attention()
     head_fb = isolated_head_ce()
     head_fb_chunked = isolated_head_ce(chunk_rows=8192)
+    embed_fb, ln_fb = isolated_embed_ln()
 
     # analytic model FLOPs (XLA's count excludes the Pallas kernels):
     # 6*matmul_params per token + attention 12*S*d_model per token f+b
@@ -225,6 +264,9 @@ def main():
         "head_ce_fb_ms": round(head_fb * 1e3, 2),
         "head_ce_fb_chunked_ms": round(head_fb_chunked * 1e3, 2),
         "blocked_ce_saving_ms": round((head_fb - head_fb_chunked) * 1e3, 2),
+        "embed_fb_ms": round(embed_fb * 1e3, 2),
+        # 2 per block + the final ln_f = 25 LayerNorms in the step
+        "layernorms_fb_ms_total": round(ln_fb * (2 * LAYERS + 1) * 1e3, 2),
         "acc_metrics_cost_ms": round((step_s - step_noacc_s) * 1e3, 2),
         "n_dots_in_hlo": len(dots),
         "dot_flops_t": round(dot_flops / 1e12, 2),
