@@ -63,6 +63,33 @@ def test_for_dataset_mapping():
         normalize.for_dataset("lm")
 
 
+def test_for_config_matrix():
+    """The single-source wire→normalize decision both training paths
+    (SPMD runner and async PS) consult."""
+    from dtf_tpu.config import Config
+    from dtf_tpu.data.base import CIFAR10, IMAGENET, LM
+
+    def cfg(**kw):
+        return Config(model="resnet20", dataset="cifar10", **kw)
+
+    # uint8 wire + real data ⇒ the dataset's on-chip fn
+    assert (normalize.for_config(cfg(data_dir="/d", input_wire="uint8"),
+                                 CIFAR10)
+            is normalize.cifar_standardize)
+    assert (normalize.for_config(cfg(data_dir="/d", input_wire="uint8"),
+                                 IMAGENET)
+            is normalize.imagenet_mean_subtract)
+    # f32 wire ⇒ host-normalized, nothing on-chip
+    assert normalize.for_config(
+        cfg(data_dir="/d", input_wire="float32"), CIFAR10) is None
+    # synthetic data (flag or missing data_dir) ⇒ None
+    assert normalize.for_config(
+        cfg(data_dir="/d", use_synthetic_data=True), CIFAR10) is None
+    assert normalize.for_config(cfg(), CIFAR10) is None
+    # token-sequence datasets have no image normalization
+    assert normalize.for_config(cfg(data_dir="/d"), LM) is None
+
+
 # ---------------------------------------------------------------------------
 # cifar pipeline: both wires see the same pixels
 # ---------------------------------------------------------------------------
