@@ -79,7 +79,8 @@ DEFAULT_HEADS = 6
 
 
 def build_trainer(batch: int, remat: bool, seq: int = SEQ,
-                  heads: int = DEFAULT_HEADS, report_acc: bool = False):
+                  heads: int = DEFAULT_HEADS, report_acc: bool = False,
+                  remat_policy: str | None = None):
     import dataclasses
 
     from dtf_tpu.config import Config
@@ -97,20 +98,22 @@ def build_trainer(batch: int, remat: bool, seq: int = SEQ,
     cfg = Config(model="transformer", dataset="lm", dtype="bf16",
                  batch_size=batch, distribution_strategy="tpu",
                  optimizer="adamw", skip_eval=True, train_steps=1,
-                 remat=remat, report_accuracy_metrics=report_acc)
+                 remat=remat, report_accuracy_metrics=report_acc,
+                 remat_policy=remat_policy)
     rt = initialize(cfg)
     rt.shard_seq = True
     model, _ = build_model("transformer", num_classes=VOCAB,
                            dtype=jnp.bfloat16, num_layers=12, d_model=768,
                            num_heads=heads, d_ff=3072, max_seq_len=seq,
-                           remat=remat)
+                           remat=remat, remat_policy=remat_policy)
     trainer = Trainer(cfg, rt, model, 0.0,
                       dataclasses.replace(LM, seq_len=seq))
     return trainer, rt
 
 
 def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
-                seq: int = SEQ, heads: int = DEFAULT_HEADS):
+                seq: int = SEQ, heads: int = DEFAULT_HEADS,
+                remat_policy: str | None = None):
     n_chips = len(jax.devices())
     err = None
     # per-chip batch candidates scale down with sequence length
@@ -119,7 +122,8 @@ def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
     for per_chip in dict.fromkeys(cands):
         batch = per_chip * n_chips
         try:
-            trainer, rt = build_trainer(batch, remat, seq, heads)
+            trainer, rt = build_trainer(batch, remat, seq, heads,
+                                        remat_policy=remat_policy)
             rng = np.random.default_rng(0)
             tokens = rng.integers(0, VOCAB, (batch, seq)).astype(np.int32)
             labels = np.roll(tokens, -1, axis=1)
@@ -398,7 +402,13 @@ def main():
         variant = sys.argv[sys.argv.index("--variant") + 1]
     remat = "--remat" in sys.argv
     usage = ("usage: bench_lm.py [--seq N] [--heads N] [--remat] "
-             "[--variant flash|gpipe|gpipe_mem|dhead]")
+             "[--remat_policy dots] [--variant flash|gpipe|gpipe_mem|dhead]")
+    remat_policy = None
+    if "--remat_policy" in sys.argv:
+        i = sys.argv.index("--remat_policy")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1] != "dots":
+            sys.exit(usage)
+        remat_policy = sys.argv[i + 1]
 
     def int_flag(name, default):
         if name not in sys.argv:
@@ -466,20 +476,30 @@ def main():
         }))
         return
 
-    r = train_bench(remat, seq=seq, heads=heads)
+    r = train_bench(remat, seq=seq, heads=heads, remat_policy=remat_policy)
     base = R2_REMAT_TOKENS_PER_SEC if remat else R2_TOKENS_PER_SEC
+    if remat_policy:
+        # a distinct recipe with no recorded round-over-round series —
+        # folding it into the remat/no-remat metric names would pollute
+        # both baselines
+        metric = f"lm_tokens_per_sec_per_chip_remat_{remat_policy}"
+    elif remat:
+        metric = "lm_tokens_per_sec_per_chip_remat"
+    else:
+        metric = "lm_tokens_per_sec_per_chip"
     print(json.dumps({
-        "metric": ("lm_tokens_per_sec_per_chip_remat" if remat
-                   else "lm_tokens_per_sec_per_chip"),
+        "metric": metric,
         "value": round(r["per_chip_tps"], 0),
         "tps_min": round(r["per_chip_tps_min"], 0),
         "tps_max": round(r["per_chip_tps_max"], 0),
         "windows": r["windows"],
         "unit": "tokens/sec/chip",
         # round-over-round baseline is the seq-2048 default-layout
-        # recipe; other seqs/head counts have no recorded baseline
+        # recipe; other seqs/head counts/policies have no recorded
+        # baseline
         "vs_baseline": (round(r["per_chip_tps"] / base, 2)
                         if seq == SEQ and heads == DEFAULT_HEADS
+                        and not remat_policy
                         else None),
         "step_ms": round(r["step_ms"], 2),
         # r4 recipe change: in-step accuracy metrics off (the
@@ -493,6 +513,7 @@ def main():
         "seq_len": seq,
         "num_heads": heads,
         "remat": remat,
+        "remat_policy": remat_policy,
         "device_kind": jax.devices()[0].device_kind,
     }))
 

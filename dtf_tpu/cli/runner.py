@@ -183,13 +183,20 @@ def run(cfg: Config) -> dict:
             while m > 1 and per_shard % m:
                 m //= 2
             model_kw = dict(model_kw, num_microbatches=max(m, 1))
-    if cfg.remat:
+    if cfg.remat or cfg.remat_policy:
         if not model_name.startswith(
                 ("transformer", "moe_transformer", "pipeline_transformer")):
+            flag = "--remat" if cfg.remat else "--remat_policy"
             raise ValueError(
-                f"--remat is implemented for the transformer families, "
+                f"{flag} is implemented for the transformer families, "
                 f"not {model_name!r}")
         model_kw = dict(model_kw, remat=True)
+        if cfg.remat_policy:
+            if not model_name.startswith("transformer"):
+                raise ValueError(
+                    "--remat_policy is implemented for the plain "
+                    f"transformer family, not {model_name!r}")
+            model_kw = dict(model_kw, remat_policy=cfg.remat_policy)
     shard_vocab = bool(cfg.shard_lm_head and model_axis is not None)
     if cfg.shard_lm_head and model_axis is None:
         raise ValueError(

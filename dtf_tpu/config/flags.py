@@ -169,6 +169,12 @@ class Config:
     # rematerialization (jax.checkpoint) around each transformer block:
     # trade recompute FLOPs for HBM — the long-context memory lever
     remat: bool = False
+    # selective remat (implies --remat): "dots" saves matmul/attention
+    # outputs and recomputes only elementwise ops in the backward — a
+    # cheaper memory lever than full remat (no MXU recompute), for
+    # contexts where activations don't fit without remat
+    # (models/transformer.py remat_policy has the measured frontier)
+    remat_policy: Optional[str] = None
     # clip gradients to this global L2 norm (computed across every
     # shard of every parameter); None = no clipping
     clip_grad_norm: Optional[float] = None

@@ -413,6 +413,14 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     o, lse = _pallas_forward(q, k, v, scale, causal, block_q, block_k,
                              interpret)
+    # named for selective remat (models/transformer.py remat_policy
+    # "dots"): the backward needs these residuals, and without the tags
+    # a policy that saves only dot_generals would re-run this whole
+    # forward kernel inside the backward pass (q/k/v recompute from the
+    # saved qkv projection for free; o/lse are the expensive part)
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 

@@ -63,6 +63,60 @@ def test_causality():
                            np.asarray(out[0, t + 1 :]))
 
 
+@pytest.mark.parametrize("kw", [dict(remat=True),
+                                dict(remat=True, remat_policy="dots"),
+                                dict(remat_policy="dots")])
+def test_remat_variants_match_baseline(kw):
+    """remat and remat_policy change what is saved between forward and
+    backward, never the math: loss and every gradient leaf must match
+    the no-remat model exactly."""
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 16)).astype(np.int32))
+
+    def loss_fn(model):
+        variables = model.init(jax.random.key(0), tokens)
+
+        def loss(v):
+            logits = model.apply(v, tokens)
+            return jnp.mean((logits - 1.0) ** 2)
+
+        return (jax.jit(loss)(variables),
+                jax.jit(jax.grad(loss))(variables))
+
+    base_loss, base_grads = loss_fn(tiny_model())
+    got_loss, got_grads = loss_fn(tiny_model(**kw))
+    np.testing.assert_array_equal(np.asarray(base_loss),
+                                  np.asarray(got_loss))
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(base_grads),
+            jax.tree_util.tree_leaves_with_path(got_grads)):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=jax.tree_util.keystr(pa))
+
+
+def test_remat_policy_unknown_name_raises():
+    model = tiny_model(remat_policy="everything")
+    with pytest.raises(ValueError, match="remat_policy"):
+        model.init(jax.random.key(0), jnp.zeros((1, 16), jnp.int32))
+
+
+def test_remat_policy_cli(tiny_transformer_registry):
+    """--remat_policy dots trains through the runner (implies remat)."""
+    stats = run(base_cfg(distribution_strategy="off", train_steps=1,
+                         remat_policy="dots"))
+    assert np.isfinite(stats["loss"])
+
+
+def test_remat_policy_rejected_for_resnet():
+    with pytest.raises(ValueError, match="remat"):
+        run(Config(model="resnet20", dataset="cifar10",
+                   use_synthetic_data=True, train_steps=1, batch_size=4,
+                   distribution_strategy="off", skip_eval=True,
+                   skip_checkpoint=True, model_dir="",
+                   remat_policy="dots"))
+
+
 def test_ring_model_matches_single_device(eight_devices):
     """Same params, same tokens: the seq-sharded ring-attention model
     must produce the flash/blockwise model's logits."""
