@@ -111,22 +111,26 @@ def build_trainer(batch: int, remat: bool, seq: int = SEQ,
     return trainer, rt
 
 
+def _batch_cands(seq: int):
+    """Per-chip batch candidates, largest first, scaling down with
+    sequence length — shared by train_bench (OOM fallback) and
+    remat_mem so the memory table measures the same programs the
+    throughput numbers time."""
+    return list(dict.fromkeys(
+        max(1, m * SEQ // seq) for m in (16, 8, 4)))
+
+
 def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
                 seq: int = SEQ, heads: int = DEFAULT_HEADS,
                 remat_policy: str | None = None):
     n_chips = len(jax.devices())
     err = None
-    # per-chip batch candidates scale down with sequence length
-    cands = [max(1, 16 * SEQ // seq), max(1, 8 * SEQ // seq),
-             max(1, 4 * SEQ // seq)]
-    for per_chip in dict.fromkeys(cands):
+    for per_chip in _batch_cands(seq):
         batch = per_chip * n_chips
         try:
             trainer, rt = build_trainer(batch, remat, seq, heads,
                                         remat_policy=remat_policy)
-            rng = np.random.default_rng(0)
-            tokens = rng.integers(0, VOCAB, (batch, seq)).astype(np.int32)
-            labels = np.roll(tokens, -1, axis=1)
+            tokens, labels = _flagship_tokens(batch, seq)
             state = trainer.init_state(jax.random.key(0), (tokens, labels))
             sharded = rt.shard_batch((tokens, labels))
 
@@ -383,17 +387,80 @@ def gpipe_mem(pp: int = 4):
                 try:
                     compiled = trainer.train_step.lower(
                         state, *sharded).compile()
-                    ma = compiled.memory_analysis()
-                    ma = ma[0] if isinstance(ma, (list, tuple)) else ma
-                    row["temp_mb"] = round(
-                        ma.temp_size_in_bytes / 2**20, 1)
-                    row["total_mb"] = round(
-                        (ma.temp_size_in_bytes + ma.argument_size_in_bytes
-                         + ma.output_size_in_bytes) / 2**20, 1)
+                    temp, total = _buffer_sizes(compiled)
+                    row["temp_mb"] = round(temp / 2**20, 1)
+                    row["total_mb"] = round(total / 2**20, 1)
                 except Exception as e:  # backend without memory stats
                     row["error"] = str(e)[:80]
                 rows.append(row)
     return dict(pp=pp, batch=batch, seq=seq, rows=rows)
+
+
+def _buffer_sizes(compiled):
+    """(temp_bytes, total_bytes) from a compiled step's XLA buffer
+    assignment — the one unwrap/sum shared by every memory table."""
+    ma = compiled.memory_analysis()
+    ma = ma[0] if isinstance(ma, (list, tuple)) else ma
+    total = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+             + ma.output_size_in_bytes)
+    return ma.temp_size_in_bytes, total
+
+
+def _flagship_tokens(batch: int, seq: int):
+    """The one token/label recipe every flagship-step bench shares —
+    the memory table must measure the same program the throughput
+    numbers time."""
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, VOCAB, (batch, seq)).astype(np.int32)
+    labels = np.roll(tokens, -1, axis=1)
+    return tokens, labels
+
+
+def remat_mem():
+    """Peak-memory table for the remat frontier: XLA's buffer
+    assignment (temp + args + output) of the compiled flagship step at
+    none / dots / full remat across the seq lengths the README quotes.
+    This table is what falsified the r2/r3 belief that seq 16384 needs
+    remat: the no-remat step fits (13.0 GB total on a 16 GB v5e) and
+    runs faster than either remat flavor.
+
+    Compiles from abstract avals (jax.eval_shape of init_state) — no
+    state is ever allocated on the chip, so marginal configs see the
+    true buffer requirement, not one inflated by a previous config's
+    still-referenced arrays."""
+    rows = []
+    for seq in (SEQ, 16384, 32768):
+        # the throughput bench falls back to smaller candidates on OOM
+        # — mirror it, recording the candidate each row compiled at
+        for policy in ("none", "dots", "full"):
+            row, err = None, None
+            for per_chip in _batch_cands(seq):
+                batch = per_chip * len(jax.devices())
+                row = dict(seq=seq, policy=policy, per_chip_batch=per_chip)
+                try:
+                    trainer, rt = build_trainer(
+                        batch, policy == "full", seq, DEFAULT_HEADS,
+                        remat_policy="dots" if policy == "dots" else None)
+                    tokens, labels = _flagship_tokens(batch, seq)
+                    state_avals = jax.eval_shape(
+                        trainer.init_state, jax.random.key(0),
+                        (tokens, labels))
+                    batch_avals = tuple(
+                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in (tokens, labels))
+                    compiled = trainer.train_step.lower(
+                        state_avals, *batch_avals).compile()
+                    temp, total = _buffer_sizes(compiled)
+                    row["temp_gb"] = round(temp / 2**30, 2)
+                    row["total_gb"] = round(total / 2**30, 2)
+                    break
+                except Exception as e:
+                    err = "OOM" if is_oom(e) else str(e)[:80]
+                    row["error"] = err
+                    if err != "OOM":
+                        break
+            rows.append(row)
+    return dict(rows=rows)
 
 
 def main():
@@ -402,7 +469,8 @@ def main():
         variant = sys.argv[sys.argv.index("--variant") + 1]
     remat = "--remat" in sys.argv
     usage = ("usage: bench_lm.py [--seq N] [--heads N] [--remat] "
-             "[--remat_policy dots] [--variant flash|gpipe|gpipe_mem|dhead]")
+             "[--remat_policy dots] "
+             "[--variant flash|gpipe|gpipe_mem|remat_mem|dhead]")
     remat_policy = None
     if "--remat_policy" in sys.argv:
         i = sys.argv.index("--remat_policy")
@@ -470,6 +538,15 @@ def main():
         r = gpipe_mem()
         print(json.dumps({
             "metric": "gpipe_memory_table",
+            "value": len(r["rows"]), "unit": "configs",
+            "vs_baseline": None, **r,
+            "backend": jax.default_backend(),
+        }))
+        return
+    if variant == "remat_mem":
+        r = remat_mem()
+        print(json.dumps({
+            "metric": "remat_memory_table",
             "value": len(r["rows"]), "unit": "configs",
             "vs_baseline": None, **r,
             "backend": jax.default_backend(),

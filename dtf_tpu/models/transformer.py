@@ -28,11 +28,13 @@ Design (TPU-first):
   - `remat_policy="dots"` is the selective variant: matmul outputs and
     the flash-attention output stay saved (no MXU work is recomputed),
     only LayerNorm/GELU/bias-add intermediates recompute in the
-    backward.  Measured on v5e: a substantially cheaper *memory* lever
-    than full remat (127k vs 113k tokens/s at seq 2048; +18% at seq
-    16384 where remat is mandatory), but NOT faster than no-remat when
-    memory fits — XLA:TPU materializes the recomputed elementwise ops
-    rather than fusing them into consuming matmul operands.
+    backward.  Measured on v5e (flagship recipe): a cheaper *memory*
+    lever than full remat — 127k vs 113k tokens/s at seq 2048 with
+    temp buffers 8.7 vs 6.0 GB (no-remat: 137k at 9.7 GB) — but NOT
+    faster than no-remat when memory fits: XLA:TPU materializes the
+    recomputed elementwise ops rather than fusing them into consuming
+    matmul operands (bench_lm `--variant remat_mem` carries the
+    frontier's buffer table).
 
 Use `param_partition_specs(params)` for the per-leaf PartitionSpecs
 that shard a full (replicated-shape) param tree onto the 'model' axis.

@@ -52,6 +52,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -59,7 +60,11 @@ from dtf_tpu.ops import blockwise as bw
 
 # 1024 measured fastest for the streaming kernel on v5e (block sweep
 # at seq 8k: 1024² ≈ 10.5 ms vs 512² ≈ 16 ms — fewer grid steps, same
-# capped VMEM; 2048-blocks exceed scoped VMEM and fail to compile)
+# capped VMEM; 2048-blocks exceed scoped VMEM and fail to compile).
+# Re-swept r4 at the flagship step shape [16,2048,6,128] under the
+# loop-differenced protocol: 1024² f+b 5.40 ms vs 512×1024 6.11,
+# 1024×512 6.43, 512² 7.19, 256×1024 7.63, 256² 15.3 — every
+# compilable alternative loses 13-180%, confirming the default
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
@@ -418,7 +423,6 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     # a policy that saves only dot_generals would re-run this whole
     # forward kernel inside the backward pass (q/k/v recompute from the
     # saved qkv projection for free; o/lse are the expensive part)
-    from jax.ad_checkpoint import checkpoint_name
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
