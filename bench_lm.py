@@ -398,11 +398,17 @@ def gpipe_mem(pp: int = 4):
 
 def _buffer_sizes(compiled):
     """(temp_bytes, total_bytes) from a compiled step's XLA buffer
-    assignment — the one unwrap/sum shared by every memory table."""
+    assignment — the one unwrap/sum shared by every memory table.
+
+    The train step donates its state (jit donate_argnums), and a
+    donated buffer is reported in FULL under both argument and output
+    sizes with the overlap in alias_size_in_bytes — subtract it or the
+    table overstates HBM need by the whole train-state size."""
     ma = compiled.memory_analysis()
     ma = ma[0] if isinstance(ma, (list, tuple)) else ma
     total = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
-             + ma.output_size_in_bytes)
+             + ma.output_size_in_bytes
+             - getattr(ma, "alias_size_in_bytes", 0))
     return ma.temp_size_in_bytes, total
 
 

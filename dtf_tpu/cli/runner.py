@@ -192,10 +192,6 @@ def run(cfg: Config) -> dict:
                 f"not {model_name!r}")
         model_kw = dict(model_kw, remat=True)
         if cfg.remat_policy:
-            if not model_name.startswith("transformer"):
-                raise ValueError(
-                    "--remat_policy is implemented for the plain "
-                    f"transformer family, not {model_name!r}")
             model_kw = dict(model_kw, remat_policy=cfg.remat_policy)
     shard_vocab = bool(cfg.shard_lm_head and model_axis is not None)
     if cfg.shard_lm_head and model_axis is None:

@@ -335,6 +335,12 @@ class MoETransformerLM(nn.Module):
     dispatch_mode: str = "scatter"
     use_pallas: Any = None
     remat: bool = False
+    # selective remat ("dots", models/transformer.py remat_policy):
+    # matmul/attention outputs saved, elementwise recomputed.  NB the
+    # expert all_to_all dispatch outputs are NOT dots, so the token
+    # exchange re-runs during backward recompute — same communication
+    # cost full remat already pays, at less recompute FLOPs
+    remat_policy: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -352,7 +358,12 @@ class MoETransformerLM(nn.Module):
             pos_table, offset, s_local).astype(self.dtype)
 
         dense_block, moe_block = Block, MoEBlock
-        if self.remat:
+        if self.remat_policy is not None:
+            from dtf_tpu.models.transformer import remat_policy
+            policy = remat_policy(self.remat_policy)
+            dense_block = nn.remat(Block, policy=policy)
+            moe_block = nn.remat(MoEBlock, policy=policy)
+        elif self.remat:
             dense_block = nn.remat(Block)
             moe_block = nn.remat(MoEBlock)
         for i in range(self.num_layers):

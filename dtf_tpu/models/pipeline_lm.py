@@ -87,6 +87,10 @@ class PipelinedTransformerLM(nn.Module):
     pipe_axis: Optional[str] = None
     use_pallas: Any = None
     remat: bool = False
+    # selective remat ("dots", models/transformer.py remat_policy):
+    # the raw-matmul blocks here are dot_generals, so the policy saves
+    # exactly the matmul + flash outputs and recomputes the rest
+    remat_policy: Optional[str] = None
     # interleave=2: two virtual stages per device (Megatron-style) —
     # the stage's local block stack splits into two chunks and each
     # microbatch circles the ring twice, halving the fill/drain bubble
@@ -158,7 +162,14 @@ class PipelinedTransformerLM(nn.Module):
             return h + (f @ p["fc2_k"].astype(dtype)
                         + p["fc2_b"].astype(dtype))
 
-        step = (jax.checkpoint(block_step) if self.remat else block_step)
+        if self.remat_policy is not None:
+            from dtf_tpu.models.transformer import remat_policy
+            step = jax.checkpoint(
+                block_step, policy=remat_policy(self.remat_policy))
+        elif self.remat:
+            step = jax.checkpoint(block_step)
+        else:
+            step = block_step
 
         if self.interleave not in (1, 2):
             raise ValueError(f"interleave must be 1 or 2, got "

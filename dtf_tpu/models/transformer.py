@@ -33,8 +33,10 @@ Design (TPU-first):
     temp buffers 8.7 vs 6.0 GB (no-remat: 137k at 9.7 GB) — but NOT
     faster than no-remat when memory fits: XLA:TPU materializes the
     recomputed elementwise ops rather than fusing them into consuming
-    matmul operands (bench_lm `--variant remat_mem` carries the
-    frontier's buffer table).
+    matmul operands.  On this chip the flagship fits un-remat'd through
+    seq 32768, so both remat flavors exist for larger batches, more
+    optimizer state, or smaller HBM (bench_lm `--variant remat_mem`
+    carries the frontier's buffer table).
 
 Use `param_partition_specs(params)` for the per-leaf PartitionSpecs
 that shard a full (replicated-shape) param tree onto the 'model' axis.

@@ -244,6 +244,17 @@ def test_ep_training_matches_single_device(tiny_moe_registry):
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=2e-3)
 
 
+def test_moe_remat_policy_matches_no_remat(tiny_moe_registry):
+    """--remat_policy dots on the MoE family: same trajectory as the
+    no-remat model (the expert all_to_all re-runs in the backward
+    recompute; routing decisions must come out identical)."""
+    s1 = run(base_cfg(distribution_strategy="off"))
+    s2 = run(base_cfg(distribution_strategy="off", remat_policy="dots"))
+    np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-6)
+    s3 = run(base_cfg(num_devices=4, remat_policy="dots"))
+    np.testing.assert_allclose(s1["loss"], s3["loss"], rtol=2e-3)
+
+
 def test_ep_with_seq_parallel(tiny_moe_registry):
     """dp=2 (expert group) × sp=2 ring attention, through the CLI."""
     stats = run(base_cfg(seq_parallelism=2, num_devices=4))

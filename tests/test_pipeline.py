@@ -296,6 +296,17 @@ def test_pp_with_data_parallel(tiny_pipe_registry):
     assert np.isfinite(stats["loss"])
 
 
+def test_pp_remat_policy_matches_no_remat(tiny_pipe_registry):
+    """--remat_policy dots on the pipeline family: same trajectory as
+    the no-remat model, off-mesh and as 4 stages."""
+    s1 = run(base_cfg(distribution_strategy="off"))
+    s2 = run(base_cfg(distribution_strategy="off", remat_policy="dots"))
+    np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-6)
+    s3 = run(base_cfg(model_parallelism=4, num_devices=8,
+                      num_microbatches=2, remat_policy="dots"))
+    np.testing.assert_allclose(s1["loss"], s3["loss"], rtol=2e-3)
+
+
 def test_pp_eval(tiny_pipe_registry):
     stats = run(base_cfg(model_parallelism=2, skip_eval=False))
     assert np.isfinite(stats["eval_loss"])
