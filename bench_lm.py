@@ -64,6 +64,11 @@ R2_GPIPE_SPEEDUP = 1.62
 
 SEQ = 2048
 VOCAB = 32_768
+# flagship model dims — build_trainer, the mfu_model formula, and
+# bench_profile_lm all derive from these
+D_MODEL = 768
+LAYERS = 12
+D_FF = 3072
 
 
 def _sync(x):
@@ -103,8 +108,9 @@ def build_trainer(batch: int, remat: bool, seq: int = SEQ,
     rt = initialize(cfg)
     rt.shard_seq = True
     model, _ = build_model("transformer", num_classes=VOCAB,
-                           dtype=jnp.bfloat16, num_layers=12, d_model=768,
-                           num_heads=heads, d_ff=3072, max_seq_len=seq,
+                           dtype=jnp.bfloat16, num_layers=LAYERS,
+                           d_model=D_MODEL, num_heads=heads, d_ff=D_FF,
+                           max_seq_len=seq,
                            remat=remat, remat_policy=remat_policy)
     trainer = Trainer(cfg, rt, model, 0.0,
                       dataclasses.replace(LM, seq_len=seq))
@@ -164,11 +170,24 @@ def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
                    if step_flops and peak else None)
             mfu_6n = ((6.0 * n_params * per_chip_tps) / (peak * 1e12)
                       if peak else None)
+            # true model flops: XLA's count excludes the Pallas
+            # attention kernels, and 6N ignores attention entirely —
+            # at long sequence the S² attention term DOMINATES (same
+            # formula as bench_profile_lm: causal halves the live
+            # blocks, backward does 2.5x forward).  heads·d_head =
+            # d_model, so the term is head-layout-independent.
+            matmul_params = n_params - (VOCAB + seq) * D_MODEL
+            attn_flops = (LAYERS * 4 * batch * seq * seq * D_MODEL
+                          / 2 * 3.5)
+            model_flops = 6.0 * matmul_params * batch * seq + attn_flops
+            mfu_model = ((model_flops / n_chips / step_s) / (peak * 1e12)
+                         if peak else None)
             return dict(per_chip_tps=per_chip_tps,
                         per_chip_tps_min=rates[0],
                         per_chip_tps_max=rates[2],
                         windows=3, step_ms=step_s * 1e3,
-                        mfu=mfu, mfu_6n=mfu_6n, n_params=n_params,
+                        mfu=mfu, mfu_6n=mfu_6n, mfu_model=mfu_model,
+                        n_params=n_params,
                         per_chip_batch=per_chip, n_chips=n_chips,
                         seq=seq)
         except Exception as e:
@@ -373,8 +392,9 @@ def gpipe_bench(pp: int = 4, warmup: int = 2, iters: int = 5):
 
 def gpipe_mem(pp: int = 4):
     """Peak-memory table: XLA's own buffer assignment (temp + args +
-    output) for the compiled train step, M x remat x interleave.  The
-    GPipe memory story the docs quote comes from this."""
+    output − donated-state alias, see _buffer_sizes) for the compiled
+    train step, M x remat x interleave.  The GPipe memory story the
+    docs quote comes from this."""
     mesh, dp = _gpipe_mesh(pp)
     seq, vocab, batch = 128, 512, dp * 16
     rows = []
@@ -424,11 +444,12 @@ def _flagship_tokens(batch: int, seq: int):
 
 def remat_mem():
     """Peak-memory table for the remat frontier: XLA's buffer
-    assignment (temp + args + output) of the compiled flagship step at
-    none / dots / full remat across the seq lengths the README quotes.
-    This table is what falsified the r2/r3 belief that seq 16384 needs
-    remat: the no-remat step fits (13.0 GB total on a 16 GB v5e) and
-    runs faster than either remat flavor.
+    assignment (temp + args + output − donated-state alias, see
+    _buffer_sizes) of the compiled flagship step at none / dots / full
+    remat across the seq lengths the README quotes.  This table is what
+    falsified the r2/r3 belief that long context needs remat: the
+    no-remat step fits through seq 32768 (14.9 GB total on a 16 GB
+    v5e) and runs faster than either remat flavor at every length.
 
     Compiles from abstract avals (jax.eval_shape of init_state) — no
     state is ever allocated on the chip, so marginal configs see the
@@ -590,6 +611,10 @@ def main():
         "acc_metrics": False,
         "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
         "mfu_6n": round(r["mfu_6n"], 4) if r["mfu_6n"] is not None else None,
+        # includes attention FLOPs (S²-dominant at long seq; XLA's
+        # count excludes the Pallas kernels, 6N excludes attention)
+        "mfu_model": (round(r["mfu_model"], 4)
+                      if r["mfu_model"] is not None else None),
         "n_params": r["n_params"],
         "per_chip_batch": r["per_chip_batch"],
         "n_chips": r["n_chips"],

@@ -41,8 +41,10 @@ from bench_lm import _loop_time as _bench_lm_loop_time
 from bench_lm import build_trainer
 from bench_profile import conv_table, hbm_gbps
 
-BATCH, SEQ, D_MODEL, VOCAB = 16, 2048, 768, 32_768
-HEADS, D_HEAD, D_FF, LAYERS = 6, 128, 3072, 12
+from bench_lm import D_FF, D_MODEL, LAYERS, SEQ, VOCAB  # flagship dims
+
+BATCH = 16
+HEADS, D_HEAD = 6, D_MODEL // 6
 
 # shared tunnel-jitter-proof harness (bench_lm documents the rationale)
 _loop_time = functools.partial(_bench_lm_loop_time, n1=8, n2=72, reps=6)
