@@ -47,7 +47,7 @@ _REGISTRY = {
     # measures 0.7-1.3× the wall time of the 128-deep one at half the
     # FLOPs), so head-packing constructions cancel exactly, and 12
     # heads compute 2× the softmax score elements.  Measured: flash
-    # f+b 5.7 vs 11.8 ms at the flagship shapes — 2.1×, +33%
+    # f+b 5.0 vs 11.2 ms at the flagship shapes — 2.2×, +33%
     # end-to-end tokens/s for this layout (bench_lm.py --variant
     # dhead holds the reproducible probe)
     "transformer_tpu": (

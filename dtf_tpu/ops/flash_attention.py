@@ -16,11 +16,13 @@ saved log-sum-exp (the standard flash trade: extra FLOPs for O(S²)
 less HBM traffic).  `_blockwise_bwd` (plain JAX, same math) remains as
 the portable oracle the kernels are tested against.  Measured on one
 TPU v5 lite chip, [2, 8192, 8, 128] bf16 causal (r4 sync-cancelled
-protocol): fwd 2.46 ms, backward-only 7.76 ms (bench_lm.py --variant
-flash; bwd does 2.5× the forward's FLOPs).  All three kernels stream
+protocol): fwd ~2.5-2.9 ms, backward-only ~5.8 ms (bench_lm.py
+--variant flash; bwd does 2.5× the forward's FLOPs; the bwd dropped
+25% when its kernels moved to f32-scratch accumulation with
+native-dtype output stores).  All three kernels stream
 K/V (or Q/dO) through VMEM one block per sequential grid step —
-carries live in VMEM scratch (fwd) or revisited output tiles (dq,
-dk/dv) — so VMEM stays capped at the block size regardless of
+carries live in VMEM scratch — so VMEM stays capped at the block size
+regardless of
 sequence length: seq 32k compiles and runs (fwd 7.2 ms at
 [1, 32768, 4, 128]) where a resident-K/V formulation exceeds scoped
 VMEM from seq 8k.
@@ -30,7 +32,7 @@ a mask-free accumulate (no iota/compare/select per element), and only
 straddling blocks pay the masking VPU work — measured ~10% off the
 fwd kernel at [16, 2048, 6, 128].
 
-The d_head-64 penalty (GPT-2's 12×64 layout runs ~2.1× slower f+b than
+The d_head-64 penalty (GPT-2's 12×64 layout runs ~2.2× slower f+b than
 the flagship's 6×128 at identical parameters) is intrinsic MXU
 geometry, not a kernel gap — matmul cost conserves output_tiles ×
 ceil(contraction/128) passes under every head-packing construction,
@@ -62,9 +64,13 @@ from dtf_tpu.ops import blockwise as bw
 # at seq 8k: 1024² ≈ 10.5 ms vs 512² ≈ 16 ms — fewer grid steps, same
 # capped VMEM; 2048-blocks exceed scoped VMEM and fail to compile).
 # Re-swept r4 at the flagship step shape [16,2048,6,128] under the
-# loop-differenced protocol: 1024² f+b 5.40 ms vs 512×1024 6.11,
-# 1024×512 6.43, 512² 7.19, 256×1024 7.63, 256² 15.3 — every
-# compilable alternative loses 13-180%, confirming the default
+# loop-differenced protocol (pre-scratch-store kernels — relative
+# ordering is what the sweep establishes): 1024² f+b 5.40 ms vs
+# 512×1024 6.11, 1024×512 6.43, 512² 7.19, 256×1024 7.63, 256² 15.3 —
+# every compilable alternative loses 13-180%, confirming the default;
+# a bwd-only sweep agreed (1024² 2.7 ms vs 512×1024 5.0, 1024×512
+# 5.1).  Both sweeps predate the scratch-store kernels — the relative
+# ordering, not the absolute times, is what they establish
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
@@ -201,11 +207,17 @@ def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
 # (dq: K blocks past the diagonal; dk/dv: Q blocks before it).
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dqacc_ref, *, scale, causal):
     """Grid (BH, Sq/block_q, Sk/block_k): K/V stream one block per step
     (same capped-VMEM pattern as the forward); the dq tile accumulates
-    in its revisited output ref across the sequential k dimension."""
+    in f32 VMEM scratch across the sequential k dimension and stores
+    once, in the output's native dtype, on the last step — a bf16
+    output never materializes f32 gradients in HBM.  The previous form
+    (f32 output refs + astype outside the kernel) moved ~0.9 GB/layer
+    of extra gradient bytes; measured same-session A/B: flagship step
+    238.6 → 231.9 ms (+2.9% tokens/s), micro bwd-only 7.8 → 5.8 ms at
+    [2, 8192, 8, 128]."""
     block_q = q_ref.shape[0]
     block_k = k_ref.shape[0]
     iq = pl.program_id(1)
@@ -213,7 +225,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     @pl.when(jk == 0)
     def _init():
-        dq_ref[...] = jnp.zeros_like(dq_ref)
+        dqacc_ref[...] = jnp.zeros_like(dqacc_ref)
 
     live = (jk * block_k <= (iq + 1) * block_q - 1) if causal else True
     # diagonal-only masking (see _fwd_kernel): blocks the diagonal does
@@ -241,7 +253,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-        dq_ref[...] += jax.lax.dot_general(
+        dqacc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -254,22 +266,29 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         def _tile_masked():
             _tile(True)
 
+    # unconditional (dead causal blocks still step the grid): the tile
+    # is complete once the last k block has streamed past
+    @pl.when(jk == pl.num_programs(2) - 1)
+    def _store():
+        dq_ref[...] = dqacc_ref[...].astype(dq_ref.dtype)
+
 
 def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                 dv_ref, *, scale, causal, block_q, block_k):
+                 dv_ref, dkacc_ref, dvacc_ref, *, scale, causal, block_q,
+                 block_k):
     """Grid (BH, Sk/block_k, Sq/block_q): the Pallas pipeline streams
     one [block_q] slice of Q/dO/lse/delta per step (never the full
     sequence in VMEM — the 2-D formulation VMEM-OOMed at seq 8k), and
-    dk/dv accumulate in their output refs across the sequential q-grid
-    dimension (their index_map ignores it, so the same VMEM tile is
-    revisited)."""
+    dk/dv accumulate in f32 VMEM scratch across the sequential q-grid
+    dimension, storing native-dtype outputs once on the last step
+    (see _dq_kernel)."""
     iq = pl.program_id(2)
     jk = pl.program_id(1)
 
     @pl.when(iq == 0)
     def _init():
-        dk_ref[...] = jnp.zeros_like(dk_ref)
-        dv_ref[...] = jnp.zeros_like(dv_ref)
+        dkacc_ref[...] = jnp.zeros_like(dkacc_ref)
+        dvacc_ref[...] = jnp.zeros_like(dvacc_ref)
 
     # causal: q blocks strictly above the diagonal contribute nothing
     live = ((iq + 1) * block_q - 1 >= jk * block_k) if causal else True
@@ -294,13 +313,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 jnp.int32, (1, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, bw.NEG_INF)
         p = jnp.exp(s - lse[:, None])                     # [bq, bk]
-        dv_ref[...] += jax.lax.dot_general(
+        dvacc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk_ref[...] += jax.lax.dot_general(
+        dkacc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -312,6 +331,11 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         @pl.when(live & straddles)
         def _tile_masked():
             _tile(True)
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _store():
+        dk_ref[...] = dkacc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
 
 
 def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
@@ -336,7 +360,10 @@ def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         ],
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), jnp.float32),
+        # native output dtype: accumulation lives in the f32 scratch,
+        # so a bf16 dq never round-trips f32 gradients through HBM
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse3, delta)
 
@@ -357,9 +384,11 @@ def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
             pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse3, delta)
     return dq, dk, dv
@@ -430,9 +459,10 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
-    dq, dk, dv = _pallas_backward(q, k, v, o, lse, do, scale, causal,
-                                  block_q, block_k, interpret)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # already native-dtype: the kernels accumulate in f32 scratch and
+    # store in the inputs' dtypes
+    return _pallas_backward(q, k, v, o, lse, do, scale, causal,
+                            block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
