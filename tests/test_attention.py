@@ -79,6 +79,26 @@ def test_pallas_interpret_grad(causal):
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+def test_pallas_bwd_outputs_native_dtype():
+    """Perf regression guard: the bwd kernels accumulate in f32 VMEM
+    scratch and store native-dtype outputs — bf16 inputs must yield
+    bf16 gradients straight from the kernel (an f32 output would
+    re-introduce the ~0.9 GB/layer HBM round-trip + cast pass the r4
+    scratch-store change removed)."""
+    import importlib
+    fa = importlib.import_module("dtf_tpu.ops.flash_attention")
+    rng = np.random.default_rng(11)
+    bh, sq, d = 2, 32, 16
+    q, k, v, do = (jnp.asarray(rng.normal(size=(bh, sq, d)), jnp.bfloat16)
+                   for _ in range(4))
+    scale = 1.0 / d ** 0.5
+    o, lse = fa._pallas_forward(q, k, v, scale, True, 16, 16,
+                                interpret=True)
+    dq, dk, dv = fa._pallas_backward(q, k, v, o, lse, do, scale, True,
+                                     16, 16, interpret=True)
+    assert dq.dtype == dk.dtype == dv.dtype == jnp.bfloat16
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_bwd_kernels_match_blockwise_oracle(causal):
     """Kernel backward ≡ the retained blockwise-JAX backward on the
