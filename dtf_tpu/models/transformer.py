@@ -29,8 +29,8 @@ Design (TPU-first):
     the flash-attention output stay saved (no MXU work is recomputed),
     only LayerNorm/GELU/bias-add intermediates recompute in the
     backward.  Measured on v5e (flagship recipe): a cheaper *memory*
-    lever than full remat — 127k vs 113k tokens/s at seq 2048 with
-    temp buffers 8.7 vs 6.0 GB (no-remat: 137k at 9.7 GB) — but NOT
+    lever than full remat — 131k vs 115k tokens/s at seq 2048 with
+    temp buffers 8.7 vs 6.0 GB (no-remat: 141k at 9.7 GB) — but NOT
     faster than no-remat when memory fits: XLA:TPU materializes the
     recomputed elementwise ops rather than fusing them into consuming
     matmul operands.  On this chip the flagship fits un-remat'd through
