@@ -21,7 +21,9 @@ params), bf16 activations, AdamW, flash-attention Pallas kernels — the
 long-context flagship (docs/DESIGN.md).  MFU is XLA's own flop count
 for the compiled step over the chip's peak bf16 FLOP/s (same
 convention as bench.py); `mfu_6n` is the classic 6·N·tokens/s estimate
-for cross-checking.
+for cross-checking; `mfu_model` is the honest one — 6·N matmul flops
+plus the S²-dominant causal-attention flops XLA's count can't see
+(the Pallas kernels), constant ~56% across context lengths.
 """
 
 import json
