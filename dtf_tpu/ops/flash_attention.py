@@ -74,6 +74,12 @@ from dtf_tpu.ops import blockwise as bw
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
+# base-2 softmax folding (bwd kernels): exp(x) lowers to
+# exp2(x·log2 e), so folding log2 e into the score scale deletes one
+# per-element VPU multiply from the recompute (measured neutral on
+# v5e flagship shapes — see _dq_kernel)
+_LOG2E = 1.4426950408889634
+
 
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
@@ -241,15 +247,24 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[...]
         lse = lse_ref[...][:, 0]
         delta = delta_ref[...][:, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        # base-2 softmax recompute: fold log2(e) into the scale the
+        # per-element multiply already pays, so exp() (which lowers to
+        # exp2 + a per-element multiply) becomes a raw exp2 — the lse
+        # conversion is per-ROW.  Strictly fewer VPU ops; measured
+        # NEUTRAL end-to-end on v5e at the flagship shapes (the bwd is
+        # not multiply-bound there) — kept because it can only help on
+        # shapes/chips where the VPU is the constraint.  p is equal up
+        # to f32 rounding.
+        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32
+                                 ) * (scale * _LOG2E)
         if masked:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0)
             k_pos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, bw.NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+            s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
+        p = jnp.exp2(s2 - lse[:, None])   # lse arrives base-2 (lse3)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
@@ -304,15 +319,17 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         do = do_ref[...]
         lse = lse_ref[...][:, 0]
         delta = delta_ref[...][:, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+        # base-2 recompute, see _dq_kernel
+        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32
+                                 ) * (scale * _LOG2E)
         if masked:
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, 1), 0)
             k_pos = jk * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, bw.NEG_INF)
-        p = jnp.exp(s - lse[:, None])                     # [bq, bk]
+            s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
+        p = jnp.exp2(s2 - lse[:, None])   # [bq, bk]; lse base-2 (lse3)
         dvacc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -345,7 +362,9 @@ def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     sk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)               # [BH, Sq, 1]
-    lse3 = lse[..., None]
+    # pre-converted to base 2 for the kernels' exp2 softmax recompute
+    # (the natural-log lse itself is the public residual contract)
+    lse3 = lse[..., None] * _LOG2E
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
