@@ -209,6 +209,10 @@ def lm_bench():
             "step_ms": round(r["step_ms"], 2),
             "acc_metrics": False,
             "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
+            # true model flops incl. the Pallas attention kernels XLA's
+            # count can't see (bench_lm docstring)
+            "mfu_model": (round(r["mfu_model"], 4)
+                          if r.get("mfu_model") is not None else None),
             "seq_len": bench_lm.SEQ,
         }
     except Exception as e:
