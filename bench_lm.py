@@ -24,6 +24,24 @@ convention as bench.py); `mfu_6n` is the classic 6·N·tokens/s estimate
 for cross-checking; `mfu_model` is the honest one — 6·N matmul flops
 plus the S²-dominant causal-attention flops XLA's count can't see
 (the Pallas kernels), constant ~56% across context lengths.
+
+mfu_model's attention convention, stated explicitly: fwd + 2.5×fwd for
+the backward = 3.5× total.  The extra 0.5× beyond the recompute-free
+3.0× counts ONE softmax/S recompute as model flops (flash backward
+must rebuild S from Q·K before it can form dV/dQ/dK — the recompute is
+algorithmically forced by not materializing S, not an implementation
+choice).  The kernels as written recompute more than that (dq and
+dk/dv each re-derive S and dP independently), and that excess is NOT
+counted — it shows up as lost MFU, which is the point.  A strict
+recompute-free convention would use 3.0×: to convert, rescale ONLY the
+attention term (attn_flops · 3.0/3.5) and leave the 6·N matmul term
+alone — it is convention-independent.  Cross-seq-length comparisons
+are valid either way.
+
+6·N uses `matmul_params` = N minus the embedding + position tables
+(their lookups are gathers, not matmuls).  LayerNorm scales/biases and
+matmul biases stay in the count; at these dims they are <0.1% of N and
+intentionally ignored rather than itemized.
 """
 
 import json
@@ -176,8 +194,12 @@ def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
             # attention kernels, and 6N ignores attention entirely —
             # at long sequence the S² attention term DOMINATES (same
             # formula as bench_profile_lm: causal halves the live
-            # blocks, backward does 2.5x forward).  heads·d_head =
+            # blocks, backward does 2.5x forward — the 3.5x total
+            # counts ONE forced softmax recompute as model flops; see
+            # module docstring for the convention).  heads·d_head =
             # d_model, so the term is head-layout-independent.
+            # matmul_params: N minus the two lookup tables; LN/bias
+            # params (<0.1% of N) intentionally stay in the count.
             matmul_params = n_params - (VOCAB + seq) * D_MODEL
             attn_flops = (LAYERS * 4 * batch * seq * seq * D_MODEL
                           / 2 * 3.5)

@@ -794,6 +794,10 @@ class Trainer:
         history: dict = {"loss": [], acc_key: []}
         profile_range = _parse_profile_steps(cfg.profile_steps)
         profiling = False
+        # ">= with a started flag" rather than "==": a resumed run whose
+        # start step already passed profile_range[0] must still trace the
+        # remaining in-range steps (--profile_steps contract under --resume).
+        profile_started = False
 
         for cb in callbacks:
             _call(cb, "on_train_begin", None)
@@ -811,9 +815,12 @@ class Trainer:
             for batch_idx in range(self.steps_per_epoch):
                 for cb in callbacks:
                     _call(cb, "on_batch_begin", batch_idx, None)
-                if profile_range and global_step == profile_range[0]:
+                if (profile_range and not profile_started
+                        and global_step >= profile_range[0]
+                        and global_step <= profile_range[1]):
                     jax.profiler.start_trace(cfg.model_dir)
                     profiling = True
+                    profile_started = True
                 images, labels = next(train_iter)
                 if hasattr(images, "device"):  # already sharded by prefetcher
                     sharded = (images, labels)

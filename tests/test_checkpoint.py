@@ -138,6 +138,27 @@ def test_run_with_checkpoint_and_resume(tmp_path):
     assert "loss" not in stats2 or stats2.get("train_finish_time")
 
 
+def test_profile_steps_honored_under_resume(tmp_path, monkeypatch):
+    """--profile_steps "0,10" on a resumed run whose start step (2) already
+    passed the range start must still trace the remaining in-range steps
+    (loop.py used `== range[0]`, which silently skipped the trace)."""
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "stop", calls["stop"] + 1))
+    base = dict(model="resnet20", dataset="cifar10", batch_size=8,
+                use_synthetic_data=True, skip_eval=True,
+                model_dir=str(tmp_path), log_steps=1,
+                distribution_strategy="off")
+    run(Config(**base, train_steps=2))
+    assert calls["start"] == 0  # no profile_steps on the first run
+    run(Config(**base, train_steps=4, resume=True, profile_steps="0,10"))
+    assert calls["start"] == 1 and calls["stop"] == 1
+
+
 def test_eval_only_from_checkpoint(tmp_path):
     """Train + save, then --eval_only --resume evaluates the restored
     state without training."""
