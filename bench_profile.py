@@ -115,11 +115,14 @@ def main():
     from dtf_tpu.train import Trainer
 
     batch = 256
+    remat = "--remat" in sys.argv  # selective conv_out/bn_stats policy
+    fp8 = "--fp8_resid" in sys.argv  # fp8 wgrad-residual probe
     cfg = Config(model="resnet50", dataset="imagenet", dtype="bf16",
                  batch_size=batch, distribution_strategy="tpu",
                  skip_eval=True, train_steps=1)
     rt = initialize(cfg)
-    model, l2 = build_model("resnet50", dtype=jnp.bfloat16)
+    model, l2 = build_model("resnet50", dtype=jnp.bfloat16, remat=remat,
+                            fp8_residuals=fp8)
     trainer = Trainer(cfg, rt, model, l2, IMAGENET)
     rng = np.random.default_rng(0)
     images = rng.normal(127, 60, (batch, 224, 224, 3)).astype(np.float32)
@@ -190,6 +193,7 @@ def main():
         "value": round(flops / step_s / (peak * 1e12), 4) if peak else None,
         "unit": "mfu",
         "vs_baseline": None,
+        "remat": remat, "fp8_resid": fp8,
         "step_ms": round(step_s * 1e3, 2),
         "fwd_ms": round(fwd_s * 1e3, 2),
         "bwd_update_ms": round((step_s - fwd_s) * 1e3, 2),

@@ -184,15 +184,26 @@ def run(cfg: Config) -> dict:
                 m //= 2
             model_kw = dict(model_kw, num_microbatches=max(m, 1))
     if cfg.remat or cfg.remat_policy:
-        if not model_name.startswith(
+        if model_name == "resnet50":
+            # vision remat is the selective conv_out/bn_stats policy
+            # (models/resnet.py RESNET_REMAT_POLICY) — there is no
+            # full-remat or "dots" variant to select
+            if cfg.remat_policy:
+                raise ValueError(
+                    "--remat_policy applies to the transformer families; "
+                    "resnet50 takes plain --remat (selective "
+                    "conv_out/bn_stats policy)")
+            model_kw = dict(model_kw, remat=True)
+        elif not model_name.startswith(
                 ("transformer", "moe_transformer", "pipeline_transformer")):
             flag = "--remat" if cfg.remat else "--remat_policy"
             raise ValueError(
-                f"{flag} is implemented for the transformer families, "
-                f"not {model_name!r}")
-        model_kw = dict(model_kw, remat=True)
-        if cfg.remat_policy:
-            model_kw = dict(model_kw, remat_policy=cfg.remat_policy)
+                f"{flag} is implemented for the transformer families and "
+                f"resnet50, not {model_name!r}")
+        else:
+            model_kw = dict(model_kw, remat=True)
+            if cfg.remat_policy:
+                model_kw = dict(model_kw, remat_policy=cfg.remat_policy)
     shard_vocab = bool(cfg.shard_lm_head and model_axis is not None)
     if cfg.shard_lm_head and model_axis is None:
         raise ValueError(

@@ -23,14 +23,76 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from flax.linen import normalization as _flax_norm
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 BATCH_NORM_DECAY = 0.9
 BATCH_NORM_EPSILON = 1e-5
 
 conv_init = nn.initializers.he_normal()
 dense_init = nn.initializers.normal(stddev=0.01)
+
+# Selective-remat policy for the bandwidth-bound ResNet step: save conv
+# outputs and BN batch statistics as backward residuals; recompute the
+# elementwise normalize/relu chains in the backward instead of storing
+# their outputs.  The step is HBM-floored (docs/DESIGN.md roofline:
+# 78.8 GB/step at 97.5% of peak with 65 ms of FLOP headroom), so
+# trading free VPU recompute for residual reads/writes attacks the only
+# binding constraint.  BN stats are saved so the backward never re-runs
+# the mean/var reductions (those would re-read the conv output).
+RESNET_REMAT_POLICY = jax.checkpoint_policies.save_only_these_names(
+    "conv_out", "bn_stats")
+
+
+class TaggedBatchNorm(nn.Module):
+    """nn.BatchNorm (feature-last), bit-identical by construction — it
+    calls flax's own `_compute_stats` / `_normalize` — plus
+    `checkpoint_name` tags on the batch mean/var so the selective-remat
+    policy can keep the statistics as residuals while the normalize
+    itself is recomputed.  Parameter/collection tree paths match
+    nn.BatchNorm ('scale', 'bias'; batch_stats 'mean', 'var')."""
+    use_running_average: bool = False
+    momentum: float = BATCH_NORM_DECAY
+    epsilon: float = BATCH_NORM_EPSILON
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    axis_name: Any = None  # cross-replica (sync) BN
+
+    @nn.compact
+    def __call__(self, x):
+        feature_shape = (x.shape[-1],)
+        reduction_axes = tuple(range(x.ndim - 1))
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda s: jnp.zeros(s, jnp.float32), feature_shape)
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda s: jnp.ones(s, jnp.float32), feature_shape)
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # keywords, not positions: a flax signature change must be
+            # a loud TypeError, never a silent misbinding (sync-BN's
+            # axis_name degrading to per-replica stats would be
+            # invisible to the axis_name=None bit-exactness pin)
+            mean, var = _flax_norm._compute_stats(
+                x, reduction_axes, dtype=self.dtype,
+                axis_name=self.axis_name, axis_index_groups=None)
+            mean = checkpoint_name(mean, "bn_stats")
+            var = checkpoint_name(var, "bn_stats")
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        return _flax_norm._normalize(
+            self, x, mean, var, reduction_axes, feature_axes=(-1,),
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            epsilon=self.epsilon, use_bias=True, use_scale=True,
+            bias_init=nn.initializers.zeros_init(),
+            scale_init=nn.initializers.ones_init())
 
 
 class Conv1SpaceToDepth(nn.Module):
@@ -66,6 +128,53 @@ class Conv1SpaceToDepth(nn.Module):
             padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv_fp8_resid(x, w, strides, padding):
+    """Convolution whose backward reads an fp8(e4m3) copy of the input
+    activation instead of the bf16 original ("lower-precision activation
+    storage", docs/DESIGN.md byte-lever probe).  dx is exact (needs only
+    w and the cotangent); dW sees the quantized activations."""
+    return lax.conv_general_dilated(
+        x, w, strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _conv_fp8_fwd(x, w, strides, padding):
+    y = _conv_fp8_resid(x, w, strides, padding)
+    return y, (x.astype(jnp.float8_e4m3fn), w)
+
+
+def _conv_fp8_bwd(strides, padding, res, g):
+    x8, w = res
+    x = x8.astype(w.dtype)
+    _, vjp = jax.vjp(
+        lambda xx, ww: lax.conv_general_dilated(
+            xx, ww, strides, padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), x, w)
+    return vjp(g)
+
+
+_conv_fp8_resid.defvjp(_conv_fp8_fwd, _conv_fp8_bwd)
+
+
+class Fp8ResidConv(nn.Module):
+    """nn.Conv-compatible (no-bias, feature-last) conv storing its
+    backward activation residual in fp8.  Parameter tree path matches
+    nn.Conv ('kernel'), so the L2 rule and checkpoints line up."""
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = self.kernel_size
+        w = self.param("kernel", conv_init,
+                       (kh, kw, x.shape[-1], self.features), jnp.float32)
+        return _conv_fp8_resid(x, w.astype(self.dtype),
+                               tuple(self.strides), self.padding)
+
+
 class BottleneckBlock(nn.Module):
     """conv_block / identity_block of reference resnet_model.py:46-221."""
     filters: Sequence[int]
@@ -73,32 +182,38 @@ class BottleneckBlock(nn.Module):
     projection: bool = False
     dtype: Any = jnp.float32
     bn_axis: Any = None  # axis_name for cross-replica (sync) BN
+    fp8_residuals: bool = False  # byte-lever probe, see Fp8ResidConv
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         f1, f2, f3 = self.filters
-        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
-                       dtype=self.dtype, param_dtype=jnp.float32)
+        if self.fp8_residuals and train:
+            conv = partial(Fp8ResidConv, dtype=self.dtype)
+        else:
+            conv = partial(nn.Conv, use_bias=False, kernel_init=conv_init,
+                           dtype=self.dtype, param_dtype=jnp.float32)
         # dtype=self.dtype keeps activations bf16 between convs (half the
         # HBM traffic of fp32 BN I/O — the r1 bench's top time sink); the
         # mean/var math itself is still fp32 (flax _compute_stats upcasts)
-        bn = partial(nn.BatchNorm, use_running_average=not train,
+        bn = partial(TaggedBatchNorm, use_running_average=not train,
                      axis_name=self.bn_axis,
                      momentum=BATCH_NORM_DECAY, epsilon=BATCH_NORM_EPSILON,
                      dtype=self.dtype, param_dtype=jnp.float32)
         shortcut = x
-        y = conv(f1, (1, 1), name="conv_a")(x)
+        y = checkpoint_name(conv(f1, (1, 1), name="conv_a")(x), "conv_out")
         y = bn(name="bn_a")(y)
         y = nn.relu(y)
         y = conv(f2, (3, 3), strides=(self.strides, self.strides),
                  padding="SAME", name="conv_b")(y)
+        y = checkpoint_name(y, "conv_out")
         y = bn(name="bn_b")(y)
         y = nn.relu(y)
-        y = conv(f3, (1, 1), name="conv_c")(y)
+        y = checkpoint_name(conv(f3, (1, 1), name="conv_c")(y), "conv_out")
         y = bn(name="bn_c")(y)
         if self.projection:
             shortcut = conv(f3, (1, 1), strides=(self.strides, self.strides),
                             name="conv_proj")(x)
+            shortcut = checkpoint_name(shortcut, "conv_out")
             shortcut = bn(name="bn_proj")(shortcut)
         return nn.relu(y + shortcut.astype(y.dtype))
 
@@ -111,6 +226,14 @@ class ResNet50(nn.Module):
     # stem as a space-to-depth conv (exact reformulation, see
     # Conv1SpaceToDepth); False = the literal reference conv1
     stem_space_to_depth: bool = True
+    # selective remat: save conv outputs + BN stats only, recompute the
+    # elementwise normalize/relu chains in the backward (see
+    # RESNET_REMAT_POLICY).  A bytes lever, not a memory one — the step
+    # is HBM-bound.  Identical math either way.
+    remat: bool = False
+    # store conv input residuals in fp8 for the backward wgrad (probe;
+    # changes dW numerics — see Fp8ResidConv)
+    fp8_residuals: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -125,14 +248,28 @@ class ResNet50(nn.Module):
                         use_bias=False, kernel_init=conv_init,
                         dtype=self.dtype,
                         param_dtype=jnp.float32, name="conv1")(x)
-        x = nn.BatchNorm(use_running_average=not train,
-                         axis_name=self.bn_axis,
-                         momentum=BATCH_NORM_DECAY, epsilon=BATCH_NORM_EPSILON,
-                         dtype=self.dtype, param_dtype=jnp.float32,
-                         name="bn_conv1")(x)
+        x = TaggedBatchNorm(use_running_average=not train,
+                            axis_name=self.bn_axis,
+                            momentum=BATCH_NORM_DECAY,
+                            epsilon=BATCH_NORM_EPSILON,
+                            dtype=self.dtype, param_dtype=jnp.float32,
+                            name="bn_conv1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
+        # remat only where it matters (train step); lifted nn.remat does
+        # not change variable tree paths, so train/eval stay compatible
+        block_cls = BottleneckBlock
+        if self.remat and train:
+            # prevent_cse=False: we are under jit (not pmap/scan), where
+            # the CSE-barrier workaround is unnecessary — and its
+            # optimization barriers would force XLA to materialize the
+            # recomputed elementwise chains instead of fusing them into
+            # the backward convolutions' operand reads
+            block_cls = nn.remat(BottleneckBlock,
+                                 policy=RESNET_REMAT_POLICY,
+                                 prevent_cse=False,
+                                 static_argnums=(2,))
         stages = (
             ((64, 64, 256), 3, 1),
             ((128, 128, 512), 4, 2),
@@ -140,12 +277,15 @@ class ResNet50(nn.Module):
             ((512, 512, 2048), 3, 2),
         )
         for s, (filters, blocks, stride) in enumerate(stages, start=2):
-            x = BottleneckBlock(filters, strides=stride, projection=True,
-                                dtype=self.dtype, bn_axis=self.bn_axis, name=f"stage{s}_block0")(
-                                    x, train=train)
+            x = block_cls(filters, strides=stride, projection=True,
+                          dtype=self.dtype, bn_axis=self.bn_axis,
+                          fp8_residuals=self.fp8_residuals,
+                          name=f"stage{s}_block0")(x, train)
             for b in range(1, blocks):
-                x = BottleneckBlock(filters, dtype=self.dtype, bn_axis=self.bn_axis,
-                                    name=f"stage{s}_block{b}")(x, train=train)
+                x = block_cls(filters, dtype=self.dtype,
+                              bn_axis=self.bn_axis,
+                              fp8_residuals=self.fp8_residuals,
+                              name=f"stage{s}_block{b}")(x, train)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, kernel_init=dense_init,
