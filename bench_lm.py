@@ -30,13 +30,16 @@ the backward = 3.5× total.  The extra 0.5× beyond the recompute-free
 3.0× counts ONE softmax/S recompute as model flops (flash backward
 must rebuild S from Q·K before it can form dV/dQ/dK — the recompute is
 algorithmically forced by not materializing S, not an implementation
-choice).  The kernels as written recompute more than that (dq and
-dk/dv each re-derive S and dP independently), and that excess is NOT
-counted — it shows up as lost MFU, which is the point.  A strict
-recompute-free convention would use 3.0×: to convert, rescale ONLY the
-attention term (attn_flops · 3.0/3.5) and leave the 6·N matmul term
-alone — it is convention-independent.  Cross-seq-length comparisons
-are valid either way.
+choice).  Since r5's fused single-pass backward (the default where its
+VMEM gate allows, seq ≤ 4096 at d 128 — ops/flash_attention.py), the
+hardware performs exactly that one recompute, so the convention
+matches the machine at the flagship shape; the split kernels used
+beyond the gate recompute S and dP once in EACH of dq and dk/dv, and
+that excess is NOT counted — it shows up as lost MFU, which is the
+point.  A strict recompute-free convention would use 3.0×: to convert,
+rescale ONLY the attention term (attn_flops · 3.0/3.5) and leave the
+6·N matmul term alone — it is convention-independent.
+Cross-seq-length comparisons are valid either way.
 
 6·N uses `matmul_params` = N minus the embedding + position tables
 (their lookups are gathers, not matmuls).  LayerNorm scales/biases and
@@ -221,12 +224,13 @@ def train_bench(remat: bool, warmup: int = 3, iters: int = 10,
     raise err
 
 
-def flash_bench(seq: int = 8192):
+def flash_bench(seq: int = 8192, fused=None):
     """Kernel micro: Pallas flash fwd vs bwd wall time, [2, seq, 8, 128]
     bf16 causal — the shape quoted in ops/flash_attention.py.  Timed
     with _loop_time (the r1-r3 single-dispatch windows carried the
     tunnel's ~105 ms sync + jitter; one recorded run produced
-    bwd = 0.19x fwd from exactly that)."""
+    bwd = 0.19x fwd from exactly that).  ``fused`` forces the
+    single-pass backward on/off (None = the production auto gate)."""
     from dtf_tpu.ops.flash_attention import flash_attention
 
     rng = jax.random.key(0)
@@ -236,7 +240,8 @@ def flash_bench(seq: int = 8192):
     k = jax.random.normal(kk, shape, jnp.bfloat16)
     v = jax.random.normal(vk, shape, jnp.bfloat16)
 
-    fwd_ms, fwdbwd_ms = _flash_times(q, k, v, n2_fwd=72, n2_fb=40)
+    fwd_ms, fwdbwd_ms = _flash_times(q, k, v, n2_fwd=72, n2_fb=40,
+                                     fused=fused)
     bwd_ms = max(fwdbwd_ms - fwd_ms, 0.0)
     return dict(fwd_ms=fwd_ms, bwd_ms=bwd_ms,
                 bwd_over_fwd=bwd_ms / fwd_ms if fwd_ms > 0 else None,
@@ -265,7 +270,7 @@ def _loop_time(body, init, n1: int = 16, n2: int = 144, reps: int = 5):
     return (ts[n2] - ts[n1]) / (n2 - n1)
 
 
-def _flash_times(q, k, v, n2_fwd: int = 72, n2_fb: int = 40):
+def _flash_times(q, k, v, n2_fwd: int = 72, n2_fb: int = 40, fused=None):
     """(fwd_ms, fwd+bwd_ms) of the causal flash kernels at q/k/v's
     shapes, loop-differenced; the fwd value is clamped positive (a
     jitter-inflated short window could otherwise difference ≤ 0).
@@ -279,7 +284,8 @@ def _flash_times(q, k, v, n2_fwd: int = 72, n2_fb: int = 40):
 
     def fb(i, qq):
         g = jax.grad(lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, causal=True).astype(jnp.float32)),
+            flash_attention(q, k, v, causal=True,
+                            fused_bwd=fused).astype(jnp.float32)),
             argnums=(0, 1, 2))(qq, k, v)
         return (g[0] + g[1] + g[2]).astype(jnp.bfloat16)
 
@@ -520,7 +526,7 @@ def main():
         variant = sys.argv[sys.argv.index("--variant") + 1]
     remat = "--remat" in sys.argv
     usage = ("usage: bench_lm.py [--seq N] [--heads N] [--remat] "
-             "[--remat_policy dots] "
+             "[--remat_policy dots] [--fused 0|1] "
              "[--variant flash|gpipe|gpipe_mem|remat_mem|dhead]")
     remat_policy = None
     if "--remat_policy" in sys.argv:
@@ -541,7 +547,11 @@ def main():
     heads = int_flag("--heads", DEFAULT_HEADS)
 
     if variant == "flash":
-        r = flash_bench()
+        fused = int_flag("--fused", None)
+        if fused is not None:
+            fused = bool(fused)
+        r = flash_bench(seq=seq if "--seq" in sys.argv else 8192,
+                        fused=fused)
         print(json.dumps({
             "metric": "flash_attention_bwd_over_fwd",
             "value": round(r["bwd_over_fwd"], 3),
@@ -554,6 +564,10 @@ def main():
             "vs_baseline": None,
             "protocol": "loop-differenced (r4)",
             "fwd_ms": round(r["fwd_ms"], 2), "bwd_ms": round(r["bwd_ms"], 2),
+            # which backward formulation ran: "auto" = the production
+            # VMEM gate decided; else the forced arm — recorded so A/B
+            # JSON lines are attributable without shell history
+            "fused_bwd": "auto" if fused is None else fused,
             "seq": r["seq"], "shape": r["shape"],
             "device_kind": jax.devices()[0].device_kind,
         }))

@@ -9,23 +9,33 @@ registers/VMEM, and only ever writes the [S, D] output — turning an
 O(S²) HBM traffic op into O(S·D).
 
 Grid: (batch·heads, Sq/block_q); each program streams K/V through VMEM
-in block_k slices.  The backward is two Pallas kernels of the same
-shape (dq streaming K/V; dk+dv streaming Q/dO — single writer per
-output tile, no atomics), recomputing probabilities per tile from the
-saved log-sum-exp (the standard flash trade: extra FLOPs for O(S²)
-less HBM traffic).  `_blockwise_bwd` (plain JAX, same math) remains as
-the portable oracle the kernels are tested against.  Measured on one
-TPU v5 lite chip, [2, 8192, 8, 128] bf16 causal (r4 sync-cancelled
-protocol): fwd ~2.5-2.9 ms, backward-only ~5.8 ms (bench_lm.py
---variant flash; bwd does 2.5× the forward's FLOPs; the bwd dropped
-25% when its kernels moved to f32-scratch accumulation with
-native-dtype output stores).  All three kernels stream
-K/V (or Q/dO) through VMEM one block per sequential grid step —
-carries live in VMEM scratch — so VMEM stays capped at the block size
-regardless of
-sequence length: seq 32k compiles and runs (fwd 7.2 ms at
-[1, 32768, 4, 128]) where a resident-K/V formulation exceeds scoped
-VMEM from seq 8k.
+in block_k slices.  The backward has two formulations, both
+recomputing probabilities per tile from the saved log-sum-exp (the
+standard flash trade: extra FLOPs for O(S²) less HBM traffic):
+
+- **fused** (`_dfused_kernel`, the default where its [Sq, D] f32 dq
+  scratch fits VMEM — seq ≤ 4096 at d 128): dq, dk, dv from ONE
+  traversal of the tile space — 5 tile matmuls and one softmax
+  recompute per tile vs the split pair's 7 and two.  Measured r5,
+  flagship step [16, 2048, 6, 128]: 235.2 → 218-223 ms (+5-8%
+  tokens/s, mfu_model 0.561 → 0.59-0.605), isolated bwd 3.99 → 3.31 ms.
+- **split** (`_dq_kernel` + `_dkdv_kernel`, longer sequences): dq
+  streaming K/V; dk+dv streaming Q/dO — single writer per output
+  tile, no atomics, VMEM capped at the block size regardless of
+  sequence length: seq 32k compiles and runs (fwd 7.2 ms at
+  [1, 32768, 4, 128]) where a resident-K/V formulation exceeds scoped
+  VMEM from seq 8k.
+
+`_blockwise_bwd` (plain JAX, same math) remains as the portable oracle
+both are tested against (fused ≡ split ≡ oracle,
+test_pallas_fused_bwd_matches_split).  Measured on one TPU v5 lite
+chip, [2, 8192, 8, 128] bf16 causal (r4 sync-cancelled protocol, split
+path): fwd ~2.5-3.0 ms, backward-only ~5.8-9.0 ms across sessions
+(bench_lm.py --variant flash; bwd does 2.5× the forward's FLOPs; the
+bwd dropped 25% when its kernels moved to f32-scratch accumulation
+with native-dtype output stores).  All kernels stream their long-axis
+operands through VMEM one block per sequential grid step — carries
+live in VMEM scratch.
 
 Causal masking is diagonal-only: blocks the diagonal never crosses run
 a mask-free accumulate (no iota/compare/select per element), and only
@@ -355,9 +365,126 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
 
 
+def _dfused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dk_ref, dv_ref, dqacc_ref, dkacc_ref,
+                   dvacc_ref, *, scale, causal, block_q, block_k):
+    """Single-pass backward: dq, dk, dv from ONE traversal of the
+    (q-block × k-block) tile space — the S and dP recomputes happen
+    once per tile instead of once in each of the split kernels (5 tile
+    matmuls vs the split pair's 7, and half the exp2 softmax-recompute
+    VPU work).
+
+    Grid (BH, Sk/block_k, Sq/block_q): dk/dv accumulate per k tile in
+    block-sized f32 scratch across the inner q dimension (exactly the
+    split _dkdv_kernel pattern); dq — whose accumulation runs across
+    the OUTER k dimension, where block scratch can't carry it — lives
+    in a FULL-SEQUENCE [Sq, D] f32 VMEM scratch, zeroed on the first k
+    step and sliced per q tile.  That scratch is what bounds the
+    kernel: Sq·D·4 bytes of VMEM (1 MB at the flagship 2048×128), so
+    _pallas_backward gates the fused path on _FUSED_DQ_SCRATCH_MAX and
+    falls back to the split kernels for longer sequences.  Each dq
+    tile's final value is stored (native dtype) on the last outer step;
+    earlier visits to the write-through dq output block are dead
+    stores the final visit overwrites."""
+    iq = pl.program_id(2)
+    jk = pl.program_id(1)
+    num_q = pl.num_programs(2)
+
+    @pl.when(jk == 0)
+    def _init_dq_slice():
+        dqacc_ref[pl.dslice(iq * block_q, block_q), :] = jnp.zeros(
+            (block_q, dqacc_ref.shape[1]), jnp.float32)
+
+    @pl.when(iq == 0)
+    def _init_dkdv():
+        dkacc_ref[...] = jnp.zeros_like(dkacc_ref)
+        dvacc_ref[...] = jnp.zeros_like(dvacc_ref)
+
+    live = ((iq + 1) * block_q - 1 >= jk * block_k) if causal else True
+    # diagonal-only masking (see _fwd_kernel)
+    straddles = (jk * block_k + block_k - 1 > iq * block_q) if causal \
+        else False
+
+    def _tile(masked):
+        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
+        k = k_ref[...]
+        v = v_ref[...]
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...][:, 0]
+        delta = delta_ref[...][:, 0]
+        # base-2 recompute, see _dq_kernel
+        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32
+                                 ) * (scale * _LOG2E)
+        if masked:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            k_pos = jk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
+        p = jnp.exp2(s2 - lse[:, None])   # [bq, bk]; lse base-2 (lse3)
+        dvacc_ref[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dkacc_ref[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dqacc_ref[pl.dslice(iq * block_q, block_q), :] += (
+            jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32))
+
+    @pl.when(live & jnp.logical_not(straddles) if causal else live)
+    def _tile_unmasked():
+        _tile(False)
+
+    if causal:
+        @pl.when(live & straddles)
+        def _tile_masked():
+            _tile(True)
+
+    @pl.when(iq == num_q - 1)
+    def _store_dkdv():
+        dk_ref[...] = dkacc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
+
+    # dq tile iq is complete once the last k block has passed; under
+    # causal masking contributions beyond the diagonal were dead, so
+    # storing every tile on the final outer step is always correct
+    @pl.when(jk == pl.num_programs(1) - 1)
+    def _store_dq():
+        dq_ref[...] = dqacc_ref[
+            pl.dslice(iq * block_q, block_q), :].astype(dq_ref.dtype)
+
+
+# The fused kernel's [Sq, D] f32 dq scratch must fit VMEM next to the
+# streamed tiles and the [block_q, block_k] score intermediates.
+# 2 MB (seq 4096 at d 128) measured safe; longer sequences use the
+# split kernels.
+_FUSED_DQ_SCRATCH_MAX = 2 * 1024 * 1024
+
+# Fused-kernel q-block sweep, recorded because the obvious conclusion
+# was wrong: ISOLATED loop-differenced bwd at [96, 2048, 128] measures
+# 512×1024 at 1.74-1.81 ms vs 1024² at 2.54-3.31 (1024×512 4.71,
+# 512² 2.98, 256×1024 3.17) — but the FULL flagship training step is
+# block-q-neutral (2× runs each, same process: 147.0-147.2k tokens/s
+# at 512 vs 147.2-147.6k at 1024).  The serialized micro loop amplifies
+# pipeline-ramp effects the real step (bwd sandwiched between the
+# block's matmuls, operands arriving from fusions) doesn't see.  The
+# kernel therefore keeps the shared 1024² default — one fewer special
+# case, chosen on the step-level evidence.
+
+
 def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                     interpret):
-    """All arrays [BH, S, D] (lse [BH, Sq]); returns (dq, dk, dv)."""
+                     interpret, fused=None):
+    """All arrays [BH, S, D] (lse [BH, Sq]); returns (dq, dk, dv).
+
+    ``fused``: None = auto (single-pass kernel when the [Sq, D] f32 dq
+    scratch fits _FUSED_DQ_SCRATCH_MAX); True/False = force (tests pin
+    both paths against each other and the oracle)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -365,6 +492,39 @@ def _pallas_backward(q, k, v, o, lse, do, scale, causal, block_q, block_k,
     # pre-converted to base 2 for the kernels' exp2 softmax recompute
     # (the natural-log lse itself is the public residual contract)
     lse3 = lse[..., None] * _LOG2E
+
+    if fused is None:
+        fused = sq == sk and sq * d * 4 <= _FUSED_DQ_SCRATCH_MAX
+    if fused:
+        bq = block_q
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_dfused_kernel, scale=scale, causal=causal,
+                              block_q=bq, block_k=block_k),
+            grid=(bh, sk // block_k, sq // bq),
+            in_specs=[
+                pl.BlockSpec((None, bq, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((None, bq, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, bq, d), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ],
+            scratch_shapes=[pltpu.VMEM((sq, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, do, lse3, delta)
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal),
@@ -456,14 +616,16 @@ def _blockwise_bwd(q, k, v, o, lse, do, scale, causal, block_k):
 # custom_vjp plumbing + public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret,
+           fused=None):
     o, _ = _pallas_forward(q, k, v, scale, causal, block_q, block_k,
                            interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               fused=None):
     o, lse = _pallas_forward(q, k, v, scale, causal, block_q, block_k,
                              interpret)
     # named for selective remat (models/transformer.py remat_policy
@@ -476,12 +638,12 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, fused, res, do):
     q, k, v, o, lse = res
     # already native-dtype: the kernels accumulate in f32 scratch and
     # store in the inputs' dtypes
     return _pallas_backward(q, k, v, o, lse, do, scale, causal,
-                            block_q, block_k, interpret)
+                            block_q, block_k, interpret, fused=fused)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -491,7 +653,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    use_pallas=None):
+                    use_pallas=None, fused_bwd=None):
     """Multi-head attention, flash-style.  q, k, v: [B, S, H, D].
 
     ``block_q``/``block_k``: None = auto (the measured-fastest default,
@@ -501,6 +663,10 @@ def flash_attention(q, k, v, *, causal: bool = False,
     ``use_pallas``: None = auto (Pallas on TPU, blockwise-JAX
     elsewhere); True/False = force; "interpret" = Pallas interpreter
     (CPU kernel validation).
+
+    ``fused_bwd``: None = auto (single-pass backward kernel when its
+    [Sq, D] dq scratch fits VMEM — see _dfused_kernel); True/False =
+    force (benches A/B the two formulations).
     """
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
@@ -554,5 +720,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
 
     o = _flash(merge(q), merge(k), merge(v), scale, causal, block_q,
-               block_k, interpret)
+               block_k, interpret, fused_bwd)
     return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)

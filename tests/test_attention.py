@@ -122,6 +122,33 @@ def test_pallas_bwd_kernels_match_blockwise_oracle(causal):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_fused_bwd_matches_split(causal):
+    """The single-pass fused backward (one S/dP recompute per tile)
+    must agree with the split dq + dk/dv kernels AND the blockwise
+    oracle — both paths stay live (the fused kernel's [Sq, D] dq
+    scratch gates it to shorter sequences)."""
+    import importlib
+    fa = importlib.import_module("dtf_tpu.ops.flash_attention")
+    rng = np.random.default_rng(7)
+    bh, sq, d = 3, 64, 16
+    q, k, v, do = (jnp.asarray(rng.normal(size=(bh, sq, d)), jnp.float32)
+                   for _ in range(4))
+    scale = 1.0 / d ** 0.5
+    o, lse = fa._pallas_forward(q, k, v, scale, causal, 16, 32,
+                                interpret=True)
+    got_f = fa._pallas_backward(q, k, v, o, lse, do, scale, causal, 16, 32,
+                                interpret=True, fused=True)
+    got_s = fa._pallas_backward(q, k, v, o, lse, do, scale, causal, 16, 32,
+                                interpret=True, fused=False)
+    want = fa._blockwise_bwd(q, k, v, o, lse, do, scale, causal, 32)
+    for a, b, c in zip(got_f, got_s, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def _seq_mesh(seq=4, data=2, model=1):
     devs = np.array(jax.devices()[: data * seq * model])
     return Mesh(devs.reshape(data, seq, model), MESH_AXES)
