@@ -527,7 +527,10 @@ def main():
     remat = "--remat" in sys.argv
     usage = ("usage: bench_lm.py [--seq N] [--heads N] [--remat] "
              "[--remat_policy dots] [--fused 0|1] "
-             "[--variant flash|gpipe|gpipe_mem|remat_mem|dhead]")
+             "[--variant flash|gpipe|gpipe_mem|remat_mem|dhead]\n"
+             "  --fused 1 forces the single-pass backward past its VMEM "
+             "gate; pair it with --seq <= 4096 (the [Sq,128] f32 dq "
+             "scratch must fit — flash defaults to seq 8192)")
     remat_policy = None
     if "--remat_policy" in sys.argv:
         i = sys.argv.index("--remat_policy")

@@ -130,6 +130,18 @@ class Config:
     ps_wire: str = "fp32"               # async-PS wire format: fp32 | bf16
                                         # (bf16 halves pull/push traffic;
                                         # store math stays fp32)
+    # async-PS fault tolerance (r5): the PS rank restores from
+    # <dir>/ps_store.snap at startup when present, snapshots
+    # params+velocity+version there every ps_snapshot_secs (atomic
+    # tmp+rename), and workers reconnect with backoff instead of dying
+    # with the store.  None = the reference's behavior (in-memory only,
+    # "Workers will need to restart training", ps_server/log1.log).
+    ps_snapshot_dir: Optional[str] = None
+    ps_snapshot_secs: float = 30.0
+    ps_reconnect_secs: float = 300.0    # how long workers retry a dead
+                                        # PS before giving up (only with
+                                        # ps_snapshot_dir — reconnecting
+                                        # to an unrestored store hangs)
     num_devices: Optional[int] = None   # ≈ --num_gpus: local chips to use; None = all
     worker_hosts: Optional[str] = None  # --worker_hosts "h1:p,h2:p" (imagenet_main.py:108-110)
     task_index: int = -1                # --task_index

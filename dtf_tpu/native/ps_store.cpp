@@ -43,8 +43,10 @@
 #include <cerrno>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -83,6 +85,12 @@ inline float bf16_to_f32(uint16_t h) {
 // Parameters larger than this are a corrupt/hostile request, not a real
 // model (4B f32 = 16 GiB).
 constexpr uint64_t kMaxParams = 1ull << 32;
+
+// Snapshot file format (little-endian), shared byte-for-byte with the
+// Python fallback store so either build restores the other's dump:
+//   8-byte magic "DTFPSNP1", u64 version, u64 n,
+//   f32 params[n], f32 velocity[n]
+constexpr char kSnapMagic[8] = {'D', 'T', 'F', 'P', 'S', 'N', 'P', '1'};
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
@@ -373,9 +381,12 @@ void dtf_bf16_to_f32(const uint16_t* in, float* out, int64_t n) {
   for (int64_t i = 0; i < n; ++i) out[i] = bf16_to_f32(in[i]);
 }
 
-// Starts a server on 0.0.0.0:port (port 0 = ephemeral).  Returns an
-// opaque handle or nullptr on bind failure.
-void* dtf_ps_start(int port, float momentum) {
+// Binds + listens on 0.0.0.0:port (port 0 = ephemeral) WITHOUT serving
+// yet: connections queue in the listen backlog until
+// dtf_ps_begin_accept.  The gap is where a restart restores its
+// snapshot — no worker INIT can race the restore.  Returns an opaque
+// handle or nullptr on bind failure.
+void* dtf_ps_start_paused(int port, float momentum) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -395,7 +406,19 @@ void* dtf_ps_start(int port, float momentum) {
   s->listen_fd = fd;
   s->port = ntohs(addr.sin_port);
   s->momentum = momentum;
+  return s;
+}
+
+// Starts the accept loop (idempotent is NOT needed: call exactly once).
+void dtf_ps_begin_accept(void* handle) {
+  auto* s = static_cast<PsServer*>(handle);
   s->accept_thread = std::thread(&PsServer::accept_loop, s);
+}
+
+// Starts a server and serves immediately (bind + accept).
+void* dtf_ps_start(int port, float momentum) {
+  void* s = dtf_ps_start_paused(port, momentum);
+  if (s) dtf_ps_begin_accept(s);
   return s;
 }
 
@@ -408,6 +431,75 @@ void dtf_ps_wait(void* handle, int n_done) {
   auto* s = static_cast<PsServer*>(handle);
   std::unique_lock<std::mutex> lk(s->state_mu);
   s->cv.wait(lk, [&] { return s->stopping || s->done_count >= n_done; });
+}
+
+// Atomic snapshot of params+velocity+version: copy under the lock,
+// write to <path>.tmp, fsync, rename.  A crash mid-write never damages
+// the previous snapshot.  Returns 0 on success, -1 (not initialized),
+// -2 (I/O failure).
+int dtf_ps_snapshot(void* handle, const char* path) {
+  auto* s = static_cast<PsServer*>(handle);
+  std::vector<float> params, velocity;
+  uint64_t version;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (!s->initialized) return -1;
+    params = s->params;
+    velocity = s->velocity;
+    version = s->version;
+  }
+  const std::string tmp = std::string(path) + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return -2;
+  const uint64_t n = params.size();
+  bool ok = fwrite(kSnapMagic, 1, 8, f) == 8 &&
+            fwrite(&version, 8, 1, f) == 1 && fwrite(&n, 8, 1, f) == 1 &&
+            fwrite(params.data(), 4, n, f) == n &&
+            fwrite(velocity.data(), 4, n, f) == n;
+  if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
+  ok = (fclose(f) == 0) && ok;
+  if (!ok || rename(tmp.c_str(), path) != 0) {
+    remove(tmp.c_str());
+    return -2;
+  }
+  return 0;
+}
+
+// Loads a snapshot into the store (marks it initialized, so worker
+// INITs after a restore get st=1 and pull the restored state instead
+// of re-proposing).  Returns 0 on success, -1 (open failure), -2
+// (corrupt/truncated file).
+int dtf_ps_restore(void* handle, const char* path) {
+  auto* s = static_cast<PsServer*>(handle);
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char magic[8];
+  uint64_t version, n;
+  bool ok = fread(magic, 1, 8, f) == 8 &&
+            memcmp(magic, kSnapMagic, 8) == 0 &&
+            fread(&version, 8, 1, f) == 1 && fread(&n, 8, 1, f) == 1 &&
+            n > 0 && n <= kMaxParams;
+  std::vector<float> params, velocity;
+  if (ok) {
+    try {
+      params.resize(n);
+      velocity.resize(n);
+    } catch (const std::bad_alloc&) {
+      ok = false;
+    }
+  }
+  if (ok)
+    ok = fread(params.data(), 4, n, f) == n &&
+         fread(velocity.data(), 4, n, f) == n &&
+         fgetc(f) == EOF;  // no trailing garbage
+  fclose(f);
+  if (!ok) return -2;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->params = std::move(params);
+  s->velocity = std::move(velocity);
+  s->version = version;
+  s->initialized = true;
+  return 0;
 }
 
 // Stops accepting, joins all threads, frees the handle.
