@@ -188,10 +188,25 @@ def input_bench():
     the chip benches on this 1-core host and recorded 125.5 img/s where
     an idle-host run gives ~285-296 — contention garbage 2.4x off).
     bench_input.measure() itself takes best-of-N windows and reports
-    the spread."""
+    the spread.
+
+    r5 (VERDICT r4 #5): both configurations measured every round —
+    fast_dct (JDCT_IFAST) as the nominal headline with the exact
+    default alongside (`default`, `tuned_over_default`).  The r5 A/B
+    RETIRED the r3 "+39%/core" fast_dct figure: against the r4
+    fused-batch-op + uint8-wire pipeline it re-measures at +1-2%
+    (window noise; README carries the retraction), so expect
+    tuned_over_default ≈ 1.0.  scaled_decode stays off — it only
+    engages on crops ≥2× target, rare on ImageNet-scale sources."""
     try:
         import bench_input
-        return bench_input.measure()
+        tuned = bench_input.measure(fast_dct=True)
+        default = bench_input.measure()
+        tuned["default"] = default
+        tuned["tuned_over_default"] = (
+            round(tuned["value"] / default["value"], 3)
+            if default.get("value") else None)
+        return tuned
     except Exception as e:
         return {"error": str(e)[:200]}
 

@@ -23,6 +23,14 @@ JPEG shards, with no device in the loop.  Prints ONE JSON line:
 Flags: --fast_dct (JDCT_IFAST decode), --scaled_decode (DCT-space
 1/2-1/8 decode for crops >=2x the target).
 
+bench.py's combined report (r5) measures BOTH the fast_dct and exact
+configurations every round (`tuned_over_default`).  The r5 A/B retired
+the r3 "+39%/core" fast_dct figure: against the r4 fused-batch-op +
+uint8-wire pipeline fast_dct re-measures at +1-2% — window-noise level
+(the IDCT is no longer where the time goes).  scaled_decode stays off
+everywhere because it only engages on crops ≥2× the target, which
+ImageNet-scale ~500px sources rarely produce.
+
 The reference's equivalent number: its pipeline fed ~168.6 img/s per
 P40 with tf.data's C++ kernels (ps_server/log1.log).  A multi-core TPU
 host must feed ~2,400+ img/s per chip (BENCH_r02); this bench proves

@@ -54,7 +54,7 @@ import sys
 # The gpipe variant measures a relative pipeline schedule, which needs
 # >=2 devices — force the 8-virtual-device CPU mesh before jax import.
 if "--variant" in sys.argv and any(
-        v in sys.argv for v in ("gpipe", "gpipe_mem")):
+        v in sys.argv for v in ("gpipe", "gpipe_mem", "zero_mem")):
     os.environ["JAX_PLATFORMS"] = "cpu"  # override any TPU plugin env
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_force_host_platform_device_count=8")
@@ -108,7 +108,8 @@ DEFAULT_HEADS = 6
 
 def build_trainer(batch: int, remat: bool, seq: int = SEQ,
                   heads: int = DEFAULT_HEADS, report_acc: bool = False,
-                  remat_policy: str | None = None):
+                  remat_policy: str | None = None,
+                  optimizer_sharding: bool = False):
     import dataclasses
 
     from dtf_tpu.config import Config
@@ -127,7 +128,8 @@ def build_trainer(batch: int, remat: bool, seq: int = SEQ,
                  batch_size=batch, distribution_strategy="tpu",
                  optimizer="adamw", skip_eval=True, train_steps=1,
                  remat=remat, report_accuracy_metrics=report_acc,
-                 remat_policy=remat_policy)
+                 remat_policy=remat_policy,
+                 optimizer_sharding=optimizer_sharding)
     rt = initialize(cfg)
     rt.shard_seq = True
     model, _ = build_model("transformer", num_classes=VOCAB,
@@ -472,6 +474,41 @@ def _flagship_tokens(batch: int, seq: int):
     return tokens, labels
 
 
+def _mem_row(seq: int, build_fn):
+    """Candidate-fallback compile-and-measure shared by remat_mem and
+    zero_mem: try per-chip batch candidates largest-first against
+    ``build_fn(batch) -> (trainer, rt)``, compiling from abstract avals
+    (no chip allocation), and return (row, n_params) — the row carries
+    temp_gb/total_gb or the error ("OOM" falls through to the next
+    candidate; anything else stops)."""
+    row, n_params = {}, None
+    for per_chip in _batch_cands(seq):
+        batch = per_chip * len(jax.devices())
+        row = dict(per_chip_batch=per_chip)
+        try:
+            trainer, rt = build_fn(batch)
+            tokens, labels = _flagship_tokens(batch, seq)
+            state_avals = jax.eval_shape(
+                trainer.init_state, jax.random.key(0), (tokens, labels))
+            n_params = sum(
+                int(np.prod(a.shape)) for a in
+                jax.tree_util.tree_leaves(state_avals.params))
+            batch_avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                                for a in (tokens, labels))
+            compiled = trainer.train_step.lower(
+                state_avals, *batch_avals).compile()
+            temp, total = _buffer_sizes(compiled)
+            row["temp_gb"] = round(temp / 2**30, 2)
+            row["total_gb"] = round(total / 2**30, 2)
+            break
+        except Exception as e:
+            err = "OOM" if is_oom(e) else str(e)[:80]
+            row["error"] = err
+            if err != "OOM":
+                break
+    return row, n_params
+
+
 def remat_mem():
     """Peak-memory table for the remat frontier: XLA's buffer
     assignment (temp + args + output − donated-state alias, see
@@ -488,36 +525,57 @@ def remat_mem():
     rows = []
     for seq in (SEQ, 16384, 32768):
         # the throughput bench falls back to smaller candidates on OOM
-        # — mirror it, recording the candidate each row compiled at
+        # — _mem_row mirrors it, recording the candidate compiled at
         for policy in ("none", "dots", "full"):
-            row, err = None, None
-            for per_chip in _batch_cands(seq):
-                batch = per_chip * len(jax.devices())
-                row = dict(seq=seq, policy=policy, per_chip_batch=per_chip)
-                try:
-                    trainer, rt = build_trainer(
-                        batch, policy == "full", seq, DEFAULT_HEADS,
-                        remat_policy="dots" if policy == "dots" else None)
-                    tokens, labels = _flagship_tokens(batch, seq)
-                    state_avals = jax.eval_shape(
-                        trainer.init_state, jax.random.key(0),
-                        (tokens, labels))
-                    batch_avals = tuple(
-                        jax.ShapeDtypeStruct(a.shape, a.dtype)
-                        for a in (tokens, labels))
-                    compiled = trainer.train_step.lower(
-                        state_avals, *batch_avals).compile()
-                    temp, total = _buffer_sizes(compiled)
-                    row["temp_gb"] = round(temp / 2**30, 2)
-                    row["total_gb"] = round(total / 2**30, 2)
-                    break
-                except Exception as e:
-                    err = "OOM" if is_oom(e) else str(e)[:80]
-                    row["error"] = err
-                    if err != "OOM":
-                        break
-            rows.append(row)
+            row, _ = _mem_row(seq, lambda batch: build_trainer(
+                batch, policy == "full", seq, DEFAULT_HEADS,
+                remat_policy="dots" if policy == "dots" else None))
+            rows.append(dict(seq=seq, policy=policy, **row))
     return dict(rows=rows)
+
+
+def zero_mem():
+    """ZeRO-2 decision table (VERDICT r4 #8): does gradient sharding
+    buy real headroom at the flagship recipe, or does ZeRO-1 suffice?
+
+    Measured per-device XLA buffer totals on the dp-device mesh with
+    ZeRO-1 off/on, plus the ANALYTIC upper bound of what ZeRO-2 could
+    further save: sharding the f32 gradient tree leaves at most
+    (dp-1)/dp · 4·N bytes to reclaim (the local backward still has to
+    materialize full-size local grads before any reduce-scatter — in
+    an SPMD formulation ZeRO-2 beyond ZeRO-1 is only the freeing of
+    the full grad buffers before peak).  The verdict rule: if the
+    next-larger (batch, seq) candidate's measured memory need exceeds
+    the current fit by MORE than that bound, ZeRO-2 provably cannot
+    unlock it and ZeRO-1 suffices; if the gap is within the bound,
+    ZeRO-2 is worth building.  Run on the virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+    """
+    dp = len(jax.devices())
+    rows = []
+    n_params = None
+    # seq 32768 omitted: the CPU-backend compile of the 12-layer
+    # blockwise-attention program at 32k is minutes-long on the 1-core
+    # box, and remat_mem's on-chip row already pins its total (14.9 GB)
+    for seq in (SEQ, 16384):
+        for zero1 in (False, True):
+            row, n = _mem_row(seq, lambda batch: build_trainer(
+                batch, False, seq, DEFAULT_HEADS,
+                optimizer_sharding=zero1))
+            n_params = n_params or n
+            rows.append(dict(seq=seq, zero1=zero1, **row))
+    # no fabricated zeros: if nothing compiled, the decision number is
+    # null, not "ZeRO-2 saves 0.0 GB"
+    grad_f32_gb = (4.0 * n_params / 2**30 if n_params else None)
+    return dict(dp=dp, n_params=n_params, rows=rows,
+                grad_tree_f32_gb=(round(grad_f32_gb, 3)
+                                  if grad_f32_gb else None),
+                zero2_max_additional_saving_gb=(
+                    round(grad_f32_gb * (dp - 1) / dp, 3)
+                    if grad_f32_gb else None),
+                note="zero2 bound = (dp-1)/dp of the f32 grad tree; "
+                     "compare against the total_gb gap between "
+                     "adjacent batch/seq candidates")
 
 
 def main():
@@ -527,7 +585,7 @@ def main():
     remat = "--remat" in sys.argv
     usage = ("usage: bench_lm.py [--seq N] [--heads N] [--remat] "
              "[--remat_policy dots] [--fused 0|1] "
-             "[--variant flash|gpipe|gpipe_mem|remat_mem|dhead]\n"
+             "[--variant flash|gpipe|gpipe_mem|remat_mem|zero_mem|dhead]\n"
              "  --fused 1 forces the single-pass backward past its VMEM "
              "gate; pair it with --seq <= 4096 (the [Sq,128] f32 dq "
              "scratch must fit — flash defaults to seq 8192)")
@@ -616,6 +674,17 @@ def main():
         print(json.dumps({
             "metric": "remat_memory_table",
             "value": len(r["rows"]), "unit": "configs",
+            "vs_baseline": None, **r,
+            "backend": jax.default_backend(),
+        }))
+        return
+
+    if variant == "zero_mem":
+        r = zero_mem()
+        print(json.dumps({
+            "metric": "zero2_decision_table",
+            "value": r["zero2_max_additional_saving_gb"],
+            "unit": "GB (zero2 max additional per-device saving)",
             "vs_baseline": None, **r,
             "backend": jax.default_backend(),
         }))

@@ -223,6 +223,38 @@ def _pallas_forward(q, k, v, scale, causal, block_q, block_k, interpret):
 # (dq: K blocks past the diagonal; dk/dv: Q blocks before it).
 # ---------------------------------------------------------------------------
 
+def _bwd_tile(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *,
+              scale, masked, iq, jk, block_q, block_k):
+    """One (q-block, k-block) tile of the flash backward recompute —
+    the SINGLE copy of the numerics shared by the split dq, split
+    dk/dv, and fused kernels (each applies its own accumulator updates
+    to the returned tensors).  Native-dtype operands with f32
+    accumulation (see _fwd_kernel); base-2 softmax recompute (see
+    _dq_kernel's historical note: folding log2 e into the scale turns
+    exp into a raw exp2 — lse arrives base-2 as lse3); diagonal-only
+    masking.  Returns (p, do, q, k, ds)."""
+    q = q_ref[...]
+    k = k_ref[...]
+    v = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...][:, 0]
+    delta = delta_ref[...][:, 0]
+    s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32
+                             ) * (scale * _LOG2E)
+    if masked:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
+    p = jnp.exp2(s2 - lse[:, None])   # [bq, bk]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+    return p, do, q, k, ds
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dqacc_ref, *, scale, causal):
     """Grid (BH, Sq/block_q, Sk/block_k): K/V stream one block per step
@@ -250,34 +282,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         else False
 
     def _tile(masked):
-        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
-        q = q_ref[...]
-        k = k_ref[...]
-        v = v_ref[...]
-        do = do_ref[...]
-        lse = lse_ref[...][:, 0]
-        delta = delta_ref[...][:, 0]
-        # base-2 softmax recompute: fold log2(e) into the scale the
-        # per-element multiply already pays, so exp() (which lowers to
-        # exp2 + a per-element multiply) becomes a raw exp2 — the lse
+        # the base-2 recompute historically lived here: folding
+        # log2(e) into the scale the per-element multiply already pays
+        # turns exp() (exp2 + a multiply) into a raw exp2 — the lse
         # conversion is per-ROW.  Strictly fewer VPU ops; measured
         # NEUTRAL end-to-end on v5e at the flagship shapes (the bwd is
         # not multiply-bound there) — kept because it can only help on
-        # shapes/chips where the VPU is the constraint.  p is equal up
-        # to f32 rounding.
-        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32
-                                 ) * (scale * _LOG2E)
-        if masked:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
-        p = jnp.exp2(s2 - lse[:, None])   # lse arrives base-2 (lse3)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        # shapes/chips where the VPU is the constraint.  The numerics
+        # are now single-sourced in _bwd_tile.
+        _, _, _, k, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, masked=masked, iq=iq, jk=jk,
+            block_q=block_q, block_k=block_k)
         dqacc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -322,30 +338,13 @@ def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         else False
 
     def _tile(masked):
-        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
-        k = k_ref[...]
-        v = v_ref[...]
-        q = q_ref[...]
-        do = do_ref[...]
-        lse = lse_ref[...][:, 0]
-        delta = delta_ref[...][:, 0]
-        # base-2 recompute, see _dq_kernel
-        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32
-                                 ) * (scale * _LOG2E)
-        if masked:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
-        p = jnp.exp2(s2 - lse[:, None])   # [bq, bk]; lse base-2 (lse3)
+        p, do, q, _, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, masked=masked, iq=iq, jk=jk,
+            block_q=block_q, block_k=block_k)
         dvacc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dkacc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -406,30 +405,13 @@ def _dfused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         else False
 
     def _tile(masked):
-        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
-        k = k_ref[...]
-        v = v_ref[...]
-        q = q_ref[...]
-        do = do_ref[...]
-        lse = lse_ref[...][:, 0]
-        delta = delta_ref[...][:, 0]
-        # base-2 recompute, see _dq_kernel
-        s2 = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32
-                                 ) * (scale * _LOG2E)
-        if masked:
-            q_pos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0)
-            k_pos = jk * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1)
-            s2 = jnp.where(q_pos >= k_pos, s2, bw.NEG_INF)
-        p = jnp.exp2(s2 - lse[:, None])   # [bq, bk]; lse base-2 (lse3)
+        p, do, q, k, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            scale=scale, masked=masked, iq=iq, jk=jk,
+            block_q=block_q, block_k=block_k)
         dvacc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dkacc_ref[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)

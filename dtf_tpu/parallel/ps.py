@@ -485,6 +485,7 @@ class PsClient:
         host, _, port = address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         self.reconnect_timeout = reconnect_timeout
+        self._init_msg: Optional[bytes] = None
         self._connect(connect_timeout)
 
     def _connect(self, timeout: float):
@@ -525,6 +526,18 @@ class PsClient:
                 except OSError:
                     pass
                 self._connect(remaining)
+                # re-propose our init against the restarted store:
+                # idempotent (first-wins) — it loses (st=1) against a
+                # snapshot-restored store, but re-seeds a store that
+                # restarted with NO snapshot (the pre-first-dump crash
+                # window), so workers stay alive instead of fail-fast
+                # dying on status-2 pushes
+                if self._init_msg is not None and op_name != "init":
+                    try:
+                        self.sock.sendall(self._init_msg)
+                        _recvn(self.sock, 17)
+                    except (OSError, ValueError):
+                        continue  # next loop iteration reconnects
 
     def init(self, params: np.ndarray) -> Tuple[int, int]:
         """Propose initial params; first worker wins (the
@@ -535,6 +548,7 @@ class PsClient:
         params = np.ascontiguousarray(params, np.float32)
         msg = (bytes([OP_INIT]) + struct.pack("<Q", params.size) +
                params.tobytes())
+        self._init_msg = msg  # replayed on reconnect (see _retrying)
 
         def once():
             self.sock.sendall(msg)
@@ -670,16 +684,30 @@ class _SnapshotLoop:
         self._thread.start()
 
     def _loop(self):
-        while not self._stop.wait(self.interval):
-            self._snap()
+        # poll fast only while the store is UNINITIALIZED (so the first
+        # dump lands within ~1 s of the first worker INIT — a crash in
+        # the initial ps_snapshot_secs window must not restart into an
+        # empty store with no snapshot at all).  I/O failures back off
+        # to the normal interval: a full disk must not warn at 1 Hz for
+        # the rest of training.
+        state = "uninit"
+        while True:
+            delay = (min(1.0, self.interval) if state == "uninit"
+                     else self.interval)
+            if self._stop.wait(delay):
+                return
+            state = self._snap()
 
-    def _snap(self):
+    def _snap(self) -> str:
+        """"saved" | "uninit" | "ioerror" (logged)."""
         try:
             self.server.snapshot(self.path)
+            return "saved"
         except ValueError:
-            pass  # not initialized yet — nothing to save
+            return "uninit"  # not initialized yet — nothing to save
         except OSError as e:
             log.warning("PS snapshot failed: %s", e)
+            return "ioerror"
 
     def stop(self):
         if self._thread is None:  # snapshots disabled (stale .so)

@@ -234,37 +234,85 @@ def run_imagenet(quick: bool):
     }, tmp, rate
 
 
+def _pure_compute_rate(batch: int) -> float:
+    """On-device ResNet-50 step rate at this batch: device-resident
+    inputs, sync-cancelled windows (bench.timed_train_steps).  A
+    synthetic-data `run()` can NOT measure this here — synthetic
+    ImageNet ships f32 [B,224,224,3] batches (36.8 MB) through the
+    tunnel, so it measures the wire (~27 img/s), not the chip."""
+    import jax
+
+    from bench import timed_train_steps
+    from dtf_tpu.config import Config
+    from dtf_tpu.data.base import IMAGENET
+    from dtf_tpu.models import build_model
+    from dtf_tpu.runtime import initialize
+    from dtf_tpu.train import Trainer
+
+    cfg = Config(model="resnet50", dataset="imagenet", dtype="bf16",
+                 batch_size=batch, distribution_strategy="tpu",
+                 skip_eval=True, train_steps=1)
+    import jax.numpy as jnp
+    rt = initialize(cfg)
+    model, l2 = build_model("resnet50", dtype=jnp.bfloat16)
+    trainer = Trainer(cfg, rt, model, l2, IMAGENET)
+    rng = np.random.default_rng(0)
+    images = rng.normal(127, 60, (batch, 224, 224, 3)).astype(np.float32)
+    labels = rng.integers(0, 1000, (batch,), dtype=np.int32)
+    state = trainer.init_state(jax.random.key(0), (images, labels))
+    sharded = rt.shard_batch((images, labels))
+    for _ in range(3):
+        state, m = trainer.train_step(state, *sharded)
+    jax.device_get(m["loss"])
+    step_s, _, _, _, _ = timed_train_steps(trainer.train_step, state,
+                                           sharded, windows=2, short=3,
+                                           long=13)
+    return batch / step_s
+
+
 def run_imagenet_resnet50(quick: bool, shards_dir: str,
                           input_only_rate):
     """The flagship workload shape (VERDICT r4 Missing #1): ResNet-50
-    itself training on the production JPEG path on the chip.  Two runs:
-    synthetic data (pure compute rate at this batch) and the JPEG
-    shards (the composition); with the trivial-model rate as the pure
-    input rate, the prefetcher's input/compute overlap fraction is
-      overlap = (t_input + t_compute - t_composed) / min(t_in, t_c)
-    (1 = the smaller phase fully hidden, 0 = serial execution)."""
+    itself training on the production JPEG path on the chip, alongside
+    the decomposition that explains its rate:
+      t_in   — the trivial-model-on-JPEG step time (host decode + uint8
+               wire + dispatch; everything but real compute),
+      t_c    — the pure on-device compute step time (device-resident
+               inputs, sync-cancelled windows),
+      t_real — the composed step time.
+    compute_hidden_fraction = (t_in + t_c - t_real) / t_c when t_real
+    <= t_in + t_c (1 = compute fully hidden behind input); any excess
+    t_real - (t_in + t_c) > 0 is reported as serial_overhead_ms — the
+    per-step cost the composition adds beyond its parts (in this
+    tunnel environment, the large program's per-step dispatch/sync)."""
     from dtf_tpu.cli import run
     from dtf_tpu.config import Config
 
     batch = 64
     steps = 10 if quick else 60
+    compute_rate = _pure_compute_rate(batch)
     common = dict(model="resnet50", dataset="imagenet", batch_size=batch,
                   train_steps=steps, log_steps=10, skip_eval=True,
                   skip_checkpoint=True, model_dir="", dtype="bf16")
-    # pure compute: synthetic data, no input pipeline
-    stats_c = run(Config(**common, use_synthetic_data=True))
-    compute_rate = steady_rate(stats_c, batch)
     # the composition: the real model against the JPEG path
     t0 = time.time()
     stats = run(Config(**common, data_dir=shards_dir))
     wall = time.time() - t0
     rate = steady_rate(stats, batch)
-    overlap = None
+    hidden = overhead_ms = None
     if rate and compute_rate and input_only_rate:
         t_in = 1.0 / input_only_rate
         t_c = 1.0 / compute_rate
         t_real = 1.0 / rate
-        overlap = (t_in + t_c - t_real) / min(t_in, t_c)
+        if t_real <= t_in + t_c:
+            # clamp: t_in (trivial-model run) slightly overestimates
+            # pure input time, so noise can push the ratio past 1
+            hidden = min((t_in + t_c - t_real) / t_c, 1.0)
+            overhead_ms = 0.0
+        else:
+            hidden = 0.0
+            # t_* are per-image seconds; report the per-STEP excess
+            overhead_ms = (t_real - (t_in + t_c)) * batch * 1e3
     batch_mb = batch * 224 * 224 * 3 * 1 / 2**20
     return {
         "model": "resnet50 (the real flagship model)",
@@ -273,22 +321,27 @@ def run_imagenet_resnet50(quick: bool, shards_dir: str,
         "batch_size": batch, "train_steps": steps,
         "loss_finite": bool(np.isfinite(stats["loss"])),
         "chip_fed_images_per_sec": rate,
-        "compute_only_images_per_sec": compute_rate,
+        "compute_only_images_per_sec": round(compute_rate, 1),
         "input_only_images_per_sec": input_only_rate,
-        "input_compute_overlap_fraction": (round(overlap, 3)
-                                           if overlap is not None
-                                           else None),
+        "compute_hidden_fraction": (round(hidden, 3)
+                                    if hidden is not None else None),
+        "serial_overhead_ms_per_step": (round(overhead_ms, 1)
+                                        if overhead_ms is not None
+                                        else None),
         "input_wire": "uint8",
         "batch_transfer_mb": round(batch_mb, 1),
         "wire_mb_per_sec": (round(rate / batch * batch_mb, 1)
                             if rate else None),
         "note": "input-bound through the tunnel (as the reference's "
                 "ps_server GPUs were input-bound on their slower "
-                "pipeline, README.md:255-291): the evidence here is "
-                "the full composition — TFRecord parse + C++ fused "
-                "JPEG decode + uint8 wire + DevicePrefetcher feeding "
-                "the REAL model's train step on the chip — plus how "
-                "much of the chip compute the prefetcher hides",
+                "pipeline, README.md:255-291): the evidence is the "
+                "full composition — TFRecord parse + C++ fused JPEG "
+                "decode + uint8 wire + DevicePrefetcher feeding the "
+                "REAL model's train step on the chip.  On a "
+                "co-located TPU host the wire term (the t_in bulk "
+                "here) is PCIe/DMA, and the binding constraint "
+                "becomes host decode cores vs the chip's 2,590 img/s "
+                "(bench_input cores_needed_per_chip)",
         "wall_s": round(wall, 1),
     }
 
@@ -305,6 +358,9 @@ def main():
 
     device = jax.devices()[0]
     imagenet_report, shards_dir, input_rate = run_imagenet(quick)
+    # --imagenet_only: redo just the ImageNet arms and merge into an
+    # existing report (keeps a completed multi-minute CIFAR phase)
+    imagenet_only = "--imagenet_only" in sys.argv
     report = {
         "what": "recorded end-to-end runs: production input pipelines "
                 "feeding the attached chip, with mid-run checkpoint "
@@ -312,17 +368,34 @@ def main():
         "device_kind": device.device_kind,
         "platform": device.platform,
         "quick": quick,
-        "cifar": run_cifar(quick),
-        "imagenet_input_bound": imagenet_report,
-        "imagenet_resnet50": run_imagenet_resnet50(quick, shards_dir,
-                                                   input_rate),
     }
+    if imagenet_only and os.path.exists(out):
+        with open(out) as f:
+            existing = json.load(f)
+        if quick and not existing.get("quick"):
+            sys.exit(f"refusing to merge --quick ImageNet arms into the "
+                     f"full-run report {out!r} (its evidence would "
+                     f"misrepresent how it was measured); use a "
+                     f"different --out")
+        report = existing
+    elif not imagenet_only:
+        report["cifar"] = run_cifar(quick)
+    report["imagenet_input_bound"] = imagenet_report
+    report["imagenet_resnet50"] = run_imagenet_resnet50(
+        quick, shards_dir, input_rate)
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1))
-    ok = report["cifar"]["milestone_met"]
-    print(f"\nmilestone eval top-1 >= {MILESTONE_TOP1}: "
-          f"{'MET' if ok else 'NOT MET'}")
+    if "cifar" in report:
+        ok = report["cifar"]["milestone_met"]
+        print(f"\nmilestone eval top-1 >= {MILESTONE_TOP1}: "
+              f"{'MET' if ok else 'NOT MET'}")
+    else:
+        # imagenet_only against a fresh out-file: no CIFAR phase ran,
+        # so there is no milestone to claim either way
+        ok = True
+        print("\ncifar milestone: not evaluated (--imagenet_only, "
+              "no prior report)")
     # --quick is a plumbing smoke pass (a 3-epoch budget cannot reach
     # the milestone); only full runs gate their exit code on it
     sys.exit(0 if (ok or quick) else 1)

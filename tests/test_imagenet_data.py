@@ -108,3 +108,69 @@ def test_input_fn_eval_exhausts(imagenet_dir):
                                     process_id=0, process_count=1)
     batches = list(it)
     assert len(batches) == 12 // 4
+
+
+DECODE_WORKER = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+dir_, n = sys.argv[1], int(sys.argv[2])
+from dtf_tpu.data.imagenet import imagenet_input_fn
+it = imagenet_input_fn(dir_, True, 64, seed=int(sys.argv[3]),
+                       process_id=0, process_count=1)
+for _ in range(2):
+    next(it)
+t0 = time.perf_counter()
+seen = 0
+while seen < n:
+    images, labels = next(it)
+    seen += len(labels)
+print("RATE=%.2f" % (seen / (time.perf_counter() - t0)))
+it.close()
+"""
+
+
+@pytest.mark.slow
+def test_two_process_decode_co_residency(tmp_path):
+    """The multi-core feeding claim rests on serial_fraction ~ 0
+    measured on a 1-core host (BENCH_r04); this puts cross-PROCESS
+    evidence behind the extrapolation: two decode pipelines co-resident
+    on the same host and the same shard files split the core's
+    throughput ~fairly, with no cross-process serialization collapse —
+    their SUM stays close to the solo rate.  (On an N-core host the
+    same property is what makes N input processes scale; this is the
+    strongest test a 1-core box can run.)"""
+    import os
+    import re
+    import subprocess
+    import sys as _sys
+
+    from bench_input import make_shards
+
+    shards = tmp_path / "shards"
+    shards.mkdir()
+    make_shards(str(shards), num_shards=2, images_per_shard=200)
+    script = tmp_path / "decode_worker.py"
+    script.write_text(DECODE_WORKER)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+
+    def rate_of(proc):
+        out, err = proc.communicate(timeout=300)
+        m = re.search(r"RATE=([\d.]+)", out)
+        assert m, f"no rate line:\n{out[-800:]}\n{err[-800:]}"
+        return float(m.group(1))
+
+    def spawn(seed):
+        return subprocess.Popen(
+            [_sys.executable, str(script), str(shards), "1280", str(seed)],
+            cwd=repo, env=env, text=True, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE)
+
+    solo = rate_of(spawn(0))
+    p1, p2 = spawn(1), spawn(2)
+    r1, r2 = rate_of(p1), rate_of(p2)
+    # no serialization collapse: the pair's combined throughput holds
+    # most of the solo rate (scheduling overhead only) ...
+    assert r1 + r2 > 0.7 * solo, (solo, r1, r2)
+    # ... and neither process is starved by the other
+    assert min(r1, r2) > 0.2 * solo, (solo, r1, r2)
