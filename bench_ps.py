@@ -166,15 +166,11 @@ def tpu_worker_bench(steps: int = 12, batch: int = 192) -> dict:
         stats = run(cfg)
         wall = time.time() - t0
         rate = stats.get("avg_exp_per_second") or 0.0
-        # steady steps/s from the timestamp log (drops compile), same
-        # estimator as run_record.steady_rate
-        log_ = stats.get("step_timestamp_log") or []
-        steady = None
-        if len(log_) >= 3:
-            dsteps = log_[-1].batch_index - log_[1].batch_index
-            dt = log_[-1].timestamp - log_[1].timestamp
-            if dt > 0 and dsteps > 0:
-                steady = dsteps / dt
+        # steady steps/s from the timestamp log (drops the compile
+        # window) — the one shared estimator
+        from run_record import steady_rate
+        img_rate = steady_rate(stats, batch)
+        steady = img_rate / batch if img_rate else None
         out[wire] = {
             "steps_per_sec_steady": (round(steady, 3) if steady else None),
             "images_per_sec_steady": (round(steady * batch, 1)

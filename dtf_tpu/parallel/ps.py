@@ -537,7 +537,15 @@ class PsClient:
                         self.sock.sendall(self._init_msg)
                         _recvn(self.sock, 17)
                     except (OSError, ValueError):
-                        continue  # next loop iteration reconnects
+                        # the socket may still be alive but DESYNCED
+                        # (late INIT reply bytes would be parsed as the
+                        # next op's response) — close it so the next
+                        # iteration's failure path truly reconnects
+                        try:
+                            self.sock.close()
+                        except OSError:
+                            pass
+                        continue
 
     def init(self, params: np.ndarray) -> Tuple[int, int]:
         """Propose initial params; first worker wins (the
@@ -548,7 +556,10 @@ class PsClient:
         params = np.ascontiguousarray(params, np.float32)
         msg = (bytes([OP_INIT]) + struct.pack("<Q", params.size) +
                params.tobytes())
-        self._init_msg = msg  # replayed on reconnect (see _retrying)
+        if self.reconnect_timeout:
+            # replayed on reconnect (see _retrying); without reconnect
+            # the replay is unreachable — don't pin ~4·N bytes forever
+            self._init_msg = msg
 
         def once():
             self.sock.sendall(msg)

@@ -235,39 +235,14 @@ def run_imagenet(quick: bool):
 
 
 def _pure_compute_rate(batch: int) -> float:
-    """On-device ResNet-50 step rate at this batch: device-resident
-    inputs, sync-cancelled windows (bench.timed_train_steps).  A
-    synthetic-data `run()` can NOT measure this here — synthetic
-    ImageNet ships f32 [B,224,224,3] batches (36.8 MB) through the
-    tunnel, so it measures the wire (~27 img/s), not the chip."""
-    import jax
-
-    from bench import timed_train_steps
-    from dtf_tpu.config import Config
-    from dtf_tpu.data.base import IMAGENET
-    from dtf_tpu.models import build_model
-    from dtf_tpu.runtime import initialize
-    from dtf_tpu.train import Trainer
-
-    cfg = Config(model="resnet50", dataset="imagenet", dtype="bf16",
-                 batch_size=batch, distribution_strategy="tpu",
-                 skip_eval=True, train_steps=1)
-    import jax.numpy as jnp
-    rt = initialize(cfg)
-    model, l2 = build_model("resnet50", dtype=jnp.bfloat16)
-    trainer = Trainer(cfg, rt, model, l2, IMAGENET)
-    rng = np.random.default_rng(0)
-    images = rng.normal(127, 60, (batch, 224, 224, 3)).astype(np.float32)
-    labels = rng.integers(0, 1000, (batch,), dtype=np.int32)
-    state = trainer.init_state(jax.random.key(0), (images, labels))
-    sharded = rt.shard_batch((images, labels))
-    for _ in range(3):
-        state, m = trainer.train_step(state, *sharded)
-    jax.device_get(m["loss"])
-    step_s, _, _, _, _ = timed_train_steps(trainer.train_step, state,
-                                           sharded, windows=2, short=3,
-                                           long=13)
-    return batch / step_s
+    """On-device ResNet-50 step rate at this batch: bench.run_bench's
+    device-resident sync-cancelled harness (the one copy of that
+    protocol).  A synthetic-data `run()` can NOT measure this here —
+    synthetic ImageNet ships f32 [B,224,224,3] batches (36.8 MB)
+    through the tunnel, so it measures the wire (~27 img/s), not the
+    chip."""
+    from bench import run_bench
+    return run_bench(batch, warmup=3, windows=2)["per_chip"]
 
 
 def run_imagenet_resnet50(quick: bool, shards_dir: str,
@@ -357,11 +332,24 @@ def main():
         out = sys.argv[i + 1]
 
     device = jax.devices()[0]
-    imagenet_report, shards_dir, input_rate = run_imagenet(quick)
     # --imagenet_only: redo just the ImageNet arms and merge into an
-    # existing report (keeps a completed multi-minute CIFAR phase)
+    # existing report (keeps a completed multi-minute CIFAR phase).
+    # The quick-vs-full merge refusal runs BEFORE any chip work.
     imagenet_only = "--imagenet_only" in sys.argv
-    report = {
+    existing = None
+    if imagenet_only and os.path.exists(out):
+        with open(out) as f:
+            existing = json.load(f)
+        if bool(quick) != bool(existing.get("quick")):
+            sys.exit(f"refusing to merge "
+                     f"{'--quick' if quick else 'full-run'} ImageNet "
+                     f"arms into the "
+                     f"{'quick' if existing.get('quick') else 'full-run'} "
+                     f"report {out!r} — the mixed artifact would "
+                     f"misrepresent how its arms were measured; use a "
+                     f"different --out")
+    imagenet_report, shards_dir, input_rate = run_imagenet(quick)
+    report = existing if existing is not None else {
         "what": "recorded end-to-end runs: production input pipelines "
                 "feeding the attached chip, with mid-run checkpoint "
                 "resume and full-coverage eval",
@@ -369,16 +357,7 @@ def main():
         "platform": device.platform,
         "quick": quick,
     }
-    if imagenet_only and os.path.exists(out):
-        with open(out) as f:
-            existing = json.load(f)
-        if quick and not existing.get("quick"):
-            sys.exit(f"refusing to merge --quick ImageNet arms into the "
-                     f"full-run report {out!r} (its evidence would "
-                     f"misrepresent how it was measured); use a "
-                     f"different --out")
-        report = existing
-    elif not imagenet_only:
+    if existing is None and not imagenet_only:
         report["cifar"] = run_cifar(quick)
     report["imagenet_input_bound"] = imagenet_report
     report["imagenet_resnet50"] = run_imagenet_resnet50(
