@@ -146,7 +146,13 @@ def _batch_cands(seq: int):
     """Per-chip batch candidates, largest first, scaling down with
     sequence length — shared by train_bench (OOM fallback) and
     remat_mem so the memory table measures the same programs the
-    throughput numbers time."""
+    throughput numbers time.
+
+    16 at seq 2048 is measured-optimal, not just memory-safe: r5
+    probed 24 (132.8k tokens/s) and 32 (125.0k) under the fused
+    backward — both compile and run but LOSE to 16's ~147k (the
+    larger working set degrades XLA's scheduling well before OOM,
+    the same shape as the ResNet batch-512 negative)."""
     return list(dict.fromkeys(
         max(1, m * SEQ // seq) for m in (16, 8, 4)))
 

@@ -1,4 +1,8 @@
-"""LM step roofline: where do the flagship's 254 ms go? (VERDICT r3 #3)
+"""LM step roofline: where does the flagship's step go? (VERDICT r3 #3)
+
+Step history as the kernels improved: ~254 ms (r3) → ~228 (r4
+scratch-store bwd kernels) → ~223 ms (r5 fused single-pass backward,
+mfu_model ~0.59).
 
 Sibling of bench_profile.py (the ResNet roofline), for the LM flagship
 (transformer_tpu: 12x768, 6 heads x d_head 128, seq 2048, bf16, AdamW,
