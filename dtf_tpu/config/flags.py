@@ -142,6 +142,14 @@ class Config:
                                         # PS before giving up (only with
                                         # ps_snapshot_dir — reconnecting
                                         # to an unrestored store hangs)
+    # how many store versions a restarted PS may trail what a worker
+    # already saw before the worker refuses to continue (guard against
+    # silently resuming a mid-schedule run on a store that lost its
+    # state).  Size >= cluster pushes/sec x ps_snapshot_secs + margin.
+    # Default single-sourced from parallel/ps.py DEFAULT_RESEED_TOLERANCE
+    # (10,000); kept as a literal here because Config must import
+    # without pulling the ps module — parity asserted by test_ps.
+    ps_reseed_tolerance: int = 10_000
     num_devices: Optional[int] = None   # ≈ --num_gpus: local chips to use; None = all
     worker_hosts: Optional[str] = None  # --worker_hosts "h1:p,h2:p" (imagenet_main.py:108-110)
     task_index: int = -1                # --task_index

@@ -381,10 +381,10 @@ def _dfused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     step and sliced per q tile.  That scratch is what bounds the
     kernel: Sq·D·4 bytes of VMEM (1 MB at the flagship 2048×128), so
     _pallas_backward gates the fused path on _FUSED_DQ_SCRATCH_MAX and
-    falls back to the split kernels for longer sequences.  Each dq
-    tile's final value is stored (native dtype) on the last outer step;
-    earlier visits to the write-through dq output block are dead
-    stores the final visit overwrites."""
+    falls back to the split kernels for longer sequences.  The dq
+    output tile is written on EVERY visit with the current partial sum
+    (defined value per flush; the sequentially-last flush carries the
+    complete sum) — see the store-site comment."""
     iq = pl.program_id(2)
     jk = pl.program_id(1)
     num_q = pl.num_programs(2)
@@ -433,13 +433,17 @@ def _dfused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[...] = dkacc_ref[...].astype(dk_ref.dtype)
         dv_ref[...] = dvacc_ref[...].astype(dv_ref.dtype)
 
-    # dq tile iq is complete once the last k block has passed; under
-    # causal masking contributions beyond the diagonal were dead, so
-    # storing every tile on the final outer step is always correct
-    @pl.when(jk == pl.num_programs(1) - 1)
-    def _store_dq():
-        dq_ref[...] = dqacc_ref[
-            pl.dslice(iq * block_q, block_q), :].astype(dq_ref.dtype)
+    # dq tile iq is complete once the last k block has passed (under
+    # causal masking contributions beyond the diagonal were dead).
+    # The store is UNCONDITIONAL: the output block is revisited once
+    # per outer k step, and Pallas may flush its VMEM buffer to HBM on
+    # every revisit — writing the current partial sum each visit means
+    # every flush carries a defined value and the final (sequentially
+    # last) flush carries the complete one, instead of relying on
+    # earlier flushes of an unwritten buffer being harmlessly
+    # overwritten (r5 high-effort review; measured step-neutral).
+    dq_ref[...] = dqacc_ref[
+        pl.dslice(iq * block_q, block_q), :].astype(dq_ref.dtype)
 
 
 # The fused kernel's [Sq, D] f32 dq scratch must fit VMEM next to the

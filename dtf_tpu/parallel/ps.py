@@ -130,6 +130,12 @@ class StaleNativeLib(OSError):
 # f32 velocity[n].  Written atomically (tmp + rename).
 SNAP_MAGIC = b"DTFPSNP1"
 
+# The ONE copy of the reseed-guard default (config.flags imports it for
+# --ps_reseed_tolerance): how many store versions a restarted PS may
+# trail what a worker already saw before the worker refuses to
+# continue.  Size >= cluster pushes/sec x ps_snapshot_secs + margin.
+DEFAULT_RESEED_TOLERANCE = 10_000
+
 
 class PsServer:
     """The native C++ parameter store (grpc-PS-runtime equivalent).
@@ -480,12 +486,23 @@ class PsClient:
     accepts by design.  0 disables (one failure raises, the pre-r5
     behavior)."""
 
+    # A restarted store may legitimately trail the versions this client
+    # saw by up to one snapshot interval of CLUSTER-WIDE pushes (the
+    # lost tail).  Beyond the tolerance, the store has effectively LOST
+    # the run's state — continuing silently would train a mid-schedule
+    # LR against near-initial params, which is scientifically worse
+    # than dying.  --ps_reseed_tolerance wires it from the CLI; the
+    # default is the shared DEFAULT_RESEED_TOLERANCE.
+
     def __init__(self, address: str, connect_timeout: float = 60.0,
-                 reconnect_timeout: float = 0.0):
+                 reconnect_timeout: float = 0.0,
+                 reseed_tolerance: int = DEFAULT_RESEED_TOLERANCE):
         host, _, port = address.rpartition(":")
         self.address = (host or "127.0.0.1", int(port))
         self.reconnect_timeout = reconnect_timeout
+        self.reseed_tolerance = reseed_tolerance
         self._init_msg: Optional[bytes] = None
+        self._last_version = 0  # highest store version this client saw
         self._connect(connect_timeout)
 
     def _connect(self, timeout: float):
@@ -531,11 +548,45 @@ class PsClient:
                 # snapshot-restored store, but re-seeds a store that
                 # restarted with NO snapshot (the pre-first-dump crash
                 # window), so workers stay alive instead of fail-fast
-                # dying on status-2 pushes
+                # dying on status-2 pushes.  GUARDED against the silent
+                # step-0 reset (r5 high-effort review): if this client
+                # has already seen a version far beyond what a lost
+                # snapshot tail explains, the restarted store has LOST
+                # the run — die loudly rather than continue a
+                # mid-schedule run against near-initial params.
                 if self._init_msg is not None and op_name != "init":
                     try:
-                        self.sock.sendall(self._init_msg)
-                        _recvn(self.sock, 17)
+                        # probe with the NON-MUTATING INFO first: a
+                        # store that lost the run must be refused
+                        # WITHOUT seeding it (a seeded lost store would
+                        # look plausibly-initialized to a freshly
+                        # restarted worker and resurrect the silent
+                        # step-0 reset this guard exists to prevent)
+                        self.sock.sendall(bytes([OP_INFO]))
+                        st, _, ver = struct.unpack(
+                            "<BQQ", _recvn(self.sock, 17))
+                        lost = self._last_version - ver
+                        if lost > self.reseed_tolerance:
+                            raise RuntimeError(
+                                f"restarted parameter store is at "
+                                f"version {ver} but this worker already "
+                                f"saw {self._last_version} — the store "
+                                f"lost the run's state (missing/corrupt "
+                                f"snapshot?).  Refusing to continue "
+                                f"mid-schedule from near-initial "
+                                f"params; restart the job")
+                        if st == 2:
+                            # uninitialized AND within tolerance: the
+                            # pre-first-dump crash window — re-seed
+                            if self._last_version > 0:
+                                log.error(
+                                    "ps reconnect: re-seeding a "
+                                    "restarted store from init params "
+                                    "(last seen version %d) — the "
+                                    "pre-snapshot crash window",
+                                    self._last_version)
+                            self.sock.sendall(self._init_msg)
+                            _recvn(self.sock, 17)
                     except (OSError, ValueError):
                         # the socket may still be alive but DESYNCED
                         # (late INIT reply bytes would be parsed as the
@@ -566,6 +617,7 @@ class PsClient:
             st, n, ver = struct.unpack("<BQQ", _recvn(self.sock, 17))
             if st not in (0, 1) or n != params.size:
                 raise ValueError(f"ps init rejected: status={st} size={n}")
+            self._last_version = max(self._last_version, ver)
             return st, ver
 
         return self._retrying("init", once)
@@ -587,6 +639,7 @@ class PsClient:
                 else:
                     flat = np.frombuffer(_recvn(self.sock, 4 * n),
                                          np.float32)
+                self._last_version = max(self._last_version, ver)
                 return ver, flat
             return None
 
@@ -617,6 +670,7 @@ class PsClient:
             st, ver = struct.unpack("<BQ", _recvn(self.sock, 9))
             if st != 0:
                 raise ValueError(f"ps push rejected: status={st}")
+            self._last_version = max(self._last_version, ver)
             return ver
 
         return self._retrying("push", once)
@@ -629,7 +683,34 @@ class PsClient:
         return self._retrying("info", once)
 
     def done(self) -> None:
-        self.sock.sendall(bytes([OP_DONE]))
+        """DONE rides the reconnect machinery too (r5 high-effort
+        review): a worker finishing while the PS is down must deliver
+        its DONE to the RESTARTED store, or the PS rank's
+        wait(num_workers) hangs forever one short.
+
+        The delivery cannot naively retry the DONE itself: a lost ACK
+        is indistinguishable from a lost DONE, and the store may
+        legitimately tear down the moment the last DONE lands (ack
+        loss is normal there).  So liveness is verified FIRST with a
+        retried INFO round-trip — reconnecting to a restarted store if
+        needed — and the DONE then goes out on that just-verified
+        connection with ack loss tolerated, exactly the pre-r5
+        semantics on a connection now known to be good."""
+        try:
+            self._retrying("info", lambda: (
+                self.sock.sendall(bytes([OP_INFO])),
+                _recvn(self.sock, 17)))
+            self.sock.sendall(bytes([OP_DONE]))
+        except (ValueError, OSError, RuntimeError) as e:
+            # best-effort: never fail a FINISHED worker on DONE — even
+            # the reseed guard's lost-store refusal is moot here, the
+            # work is already complete.  But say so: an undelivered
+            # DONE leaves the PS rank's wait(num_workers) hanging, and
+            # this line is the only diagnostic of which worker and why.
+            log.warning("ps done() not delivered (%s: %s) — the PS "
+                        "rank's wait() will be one DONE short",
+                        type(e).__name__, e)
+            return
         try:
             _recvn(self.sock, 1)
         except (ValueError, OSError):
@@ -890,7 +971,8 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
     # restored store
     client = PsClient(ps_address,
                       reconnect_timeout=cfg.ps_reconnect_secs
-                      if cfg.ps_snapshot_dir else 0.0)
+                      if cfg.ps_snapshot_dir else 0.0,
+                      reseed_tolerance=cfg.ps_reseed_tolerance)
     st, _ = client.init(np.asarray(jax.device_get(flat0), np.float32))
     log.info("worker %d/%d: params %d floats (%s init)", worker_id,
              num_workers, flat0.size, "won" if st == 0 else "lost")

@@ -381,6 +381,67 @@ def test_corrupt_snapshot_quarantined_not_crash_looped(tmp_path,
         srv.stop()
 
 
+def test_reseed_tolerance_default_parity():
+    """Config.ps_reseed_tolerance keeps a literal default (Config must
+    import without the ps module); this pins it to the one shared
+    constant so the two can never drift."""
+    from dtf_tpu.config import Config
+    assert Config().ps_reseed_tolerance == ps_lib.DEFAULT_RESEED_TOLERANCE
+
+
+def test_reconnect_refuses_store_that_lost_the_run():
+    """The silent step-0 reset guard (r5 review): a client that has
+    seen a version far beyond the reseed tolerance must RAISE when the
+    restarted store comes back near-empty (lost/corrupt snapshot),
+    never silently continue a mid-schedule run against re-seeded
+    initial params."""
+    srv = ps_lib.PsServer(port=0)
+    port = srv.port
+    client = ps_lib.PsClient(f"127.0.0.1:{port}", reconnect_timeout=20.0,
+                             reseed_tolerance=50)
+    client.init(np.zeros(4, np.float32))
+    g = np.ones(4, np.float32)
+    for _ in range(60):  # past the tolerance
+        client.push(0.01, g)
+    srv.stop()  # crash
+    srv2 = ps_lib.PsServer(port=port)  # restart, NO restore
+    try:
+        with pytest.raises(RuntimeError, match="lost the run"):
+            client.push(0.01, g)
+        # the refusal must NOT have seeded the lost store (a freshly
+        # restarted worker would otherwise see a plausibly-initialized
+        # store and silently continue)
+        c2 = ps_lib.PsClient(f"127.0.0.1:{port}")
+        st, n, _ = c2.info()
+        assert st == 2 and n == 0  # still uninitialized
+        c2.close()
+    finally:
+        client.close()
+        srv2.stop()
+
+
+def test_done_survives_ps_restart(tmp_path):
+    """A worker finishing while the PS is down delivers its DONE to
+    the restarted store (r5 review): wait(n) on the new incarnation
+    must unblock."""
+    path = str(tmp_path / "s.snap")
+    srv = ps_lib.PsServer(port=0)
+    port = srv.port
+    client = ps_lib.PsClient(f"127.0.0.1:{port}", reconnect_timeout=20.0)
+    client.init(np.ones(3, np.float32))
+    client.push(0.01, np.ones(3, np.float32))
+    srv.snapshot(path)
+    srv.stop()  # PS dies before the worker reports DONE
+    srv2 = ps_lib.PsServer(port=port)
+    try:
+        srv2.restore(path)
+        client.done()  # reconnects and lands on the new incarnation
+        srv2.wait(1)   # must return promptly, not hang
+        client.close()
+    finally:
+        srv2.stop()
+
+
 def test_first_snapshot_lands_fast(tmp_path):
     """The first dump must land ~1 s after the store initializes, NOT
     a full ps_snapshot_secs later — a crash inside the first interval
