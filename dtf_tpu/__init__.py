@@ -17,8 +17,11 @@ Layering (SURVEY.md §7):
   parallel  — named distribution strategies over one SPMD core; sequence
               parallelism (ring attention) primitives
   ops       — Pallas TPU kernels for hot ops
+  serve     — checkpoint→inference bridge, KV-cache decode, dynamic
+              batching engine (the checkpoints' consumer)
   utils     — BenchmarkMetric logging, stats, profiler hooks
-  cli       — entry points (cifar_main, imagenet_main, launcher)
+  cli       — entry points (cifar_main, imagenet_main, serve_main,
+              launcher)
 """
 
 __version__ = "0.1.0"

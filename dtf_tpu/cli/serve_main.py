@@ -1,0 +1,124 @@
+"""Serving entry point — checkpoint → KV-cache decode → batched traffic.
+
+The training mains end at a checkpoint; this main is its consumer: it
+loads train-format (or --export_dir-format) variables through the
+serve bridge, stands up the dynamic batching engine, drives it with
+synthetic traffic, and reports latency percentiles + tokens/s in the
+BenchmarkMetric format (--benchmark_log_dir writes metric.log).
+
+Examples:
+  # serve a trained LM checkpoint:
+  python -m dtf_tpu.cli.serve_main --model_dir /tmp/lm_run \
+      --model transformer_small --serve_requests 32
+
+  # no checkpoint yet?  --serve_random_init stands up the engine on
+  # fresh params (pipeline smoke test; answers are noise):
+  python -m dtf_tpu.cli.serve_main --serve_random_init \
+      --model transformer_small --serve_requests 8
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dtf_tpu.config import parse_flags
+
+log = logging.getLogger("dtf_tpu")
+
+SERVE_DEFAULTS = dict(
+    model="transformer_small",
+    dataset="lm",
+    skip_eval=True,
+)
+
+
+def serve(cfg, random_init: bool = False) -> dict:
+    """Build model + params + engine from a Config; run the synthetic
+    traffic demo; return the stats dict.  Library entry for tests."""
+    from dtf_tpu.models import build_model
+    from dtf_tpu.serve import ServeEngine, collect_stats, load_for_serving
+    from dtf_tpu.serve.bridge import place_for_serving
+
+    if not cfg.model.startswith("transformer"):
+        raise ValueError(
+            f"serving is implemented for the plain transformer LM family, "
+            f"not {cfg.model!r}")
+    model, _ = build_model(cfg.model, num_classes=cfg.num_classes,
+                           dtype=cfg.compute_dtype)
+    max_seq = cfg.serve_max_seq_len or model.max_seq_len
+    if random_init:
+        log.warning("--serve_random_init: serving FRESH parameters — "
+                    "pipeline smoke test only, outputs are noise")
+        variables = {"params": model.init(
+            jax.random.key(cfg.seed),
+            jnp.zeros((1, max_seq), jnp.int32))["params"]}
+        variables = place_for_serving(variables)
+    else:
+        variables = load_for_serving(model_dir=cfg.model_dir,
+                                     export_dir=cfg.export_dir)
+
+    engine = ServeEngine(
+        model, variables["params"],
+        max_batch=cfg.serve_max_batch, max_seq_len=max_seq,
+        max_delay_s=cfg.serve_max_delay_ms / 1000.0,
+        queue_size=cfg.serve_queue_size, seed=cfg.seed)
+
+    # synthetic traffic: varied-length prompts, all submitted up front
+    # (a burst — the shape that exercises batching + the queue)
+    rng = np.random.default_rng(cfg.seed)
+    vocab = model.vocab_size
+    handles = []
+    t0 = time.time()
+    for _ in range(cfg.serve_requests):
+        plen = int(rng.integers(1, cfg.serve_prompt_len + 1))
+        prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
+        handles.append(engine.submit(
+            prompt, max_new_tokens=cfg.serve_max_new_tokens,
+            temperature=cfg.serve_temperature))
+    for h in handles:
+        h.result(timeout=600)
+    wall = time.time() - t0
+    engine.stop()
+
+    stats = collect_stats(engine.completed, engine.shed_count,
+                          wall_time_s=wall)
+    if cfg.benchmark_log_dir:
+        from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
+        blog = BenchmarkFileLogger(cfg.benchmark_log_dir)
+        blog.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
+                          test_id=cfg.benchmark_test_id)
+        blog.log_serving_stats(stats)
+    out = {
+        "requests": stats.num_requests,
+        "shed": stats.num_shed,
+        "tokens_per_second": stats.tokens_per_s,
+        "latency_p50_s": stats.latency_p50_s,
+        "latency_p99_s": stats.latency_p99_s,
+        "ttft_p50_s": stats.ttft_p50_s,
+    }
+    log.info("Serve stats: %s", out)
+    return out
+
+
+def main(argv=None) -> dict:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    argv = list(argv if argv is not None else sys.argv[1:])
+    # one serving-only switch, kept out of Config: random-init serving
+    # is a smoke-test posture, not a run configuration
+    random_init = "--serve_random_init" in argv
+    if random_init:
+        argv.remove("--serve_random_init")
+    cfg = parse_flags(argv, defaults=SERVE_DEFAULTS)
+    return serve(cfg, random_init=random_init)
+
+
+if __name__ == "__main__":
+    main()

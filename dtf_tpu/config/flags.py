@@ -206,6 +206,16 @@ class Config:
     # data-parallel degree at equal communication volume
     optimizer_sharding: bool = False
 
+    # --- serving (cli/serve_main.py over dtf_tpu/serve) ---
+    serve_max_batch: int = 8            # decode slots = max concurrent sequences
+    serve_max_delay_ms: float = 5.0     # batch-fill window after first arrival
+    serve_queue_size: int = 64          # bounded admission queue (backpressure)
+    serve_max_seq_len: Optional[int] = None  # cache capacity; None = model max
+    serve_max_new_tokens: int = 32      # per-request generation budget (demo)
+    serve_temperature: float = 0.0      # 0 = greedy
+    serve_requests: int = 16            # synthetic-traffic demo request count
+    serve_prompt_len: int = 8           # synthetic prompt length (max; varied)
+
     # --- misc ---
     seed: int = 0
     verbose: int = 2                    # keras fit verbose parity (rank-gated)
@@ -271,6 +281,9 @@ class Config:
                 "stopping would silently never fire")
         if self.moe_top_k is not None and self.moe_top_k < 1:
             raise ValueError(f"moe_top_k must be >= 1, got {self.moe_top_k}")
+        if self.serve_max_batch < 1 or self.serve_queue_size < 1:
+            raise ValueError(
+                "serve_max_batch and serve_queue_size must be >= 1")
         if self.eval_only and not self.resume:
             raise ValueError(
                 "--eval_only evaluates a restored checkpoint; pass "

@@ -25,7 +25,6 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from flax.linen import normalization as _flax_norm
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
@@ -47,13 +46,36 @@ RESNET_REMAT_POLICY = jax.checkpoint_policies.save_only_these_names(
     "conv_out", "bn_stats")
 
 
+def _bn_stats(x, reduction_axes, dtype, axis_name):
+    """Batch mean/variance, vendored op-for-op from flax's
+    ``_compute_stats`` (the real-input, fast-variance path) so a flax
+    upgrade can't rename a private helper out from under ResNet import
+    (ADVICE r5): reductions promoted to ≥ f32, Var = E[x²] − E[x]² with
+    the negative-roundoff clamp, and the distributed (sync-BN) form
+    stacking [mean, mean-of-squares] into ONE ``lax.pmean``.  Parity is
+    pinned by test_tagged_batchnorm_bit_exact_vs_flax."""
+    if dtype is None:
+        dtype = jnp.result_type(x)
+    dtype = jnp.promote_types(dtype, jnp.float32)
+    x = jnp.asarray(x, dtype)
+    mu = x.mean(reduction_axes)
+    mu2 = lax.square(x).mean(reduction_axes)
+    if axis_name is not None:
+        mu, mu2 = lax.pmean(jnp.stack([mu, mu2]), axis_name)
+    var = jnp.maximum(0.0, mu2 - lax.square(mu))
+    return mu, var
+
+
 class TaggedBatchNorm(nn.Module):
-    """nn.BatchNorm (feature-last), bit-identical by construction — it
-    calls flax's own `_compute_stats` / `_normalize` — plus
-    `checkpoint_name` tags on the batch mean/var so the selective-remat
-    policy can keep the statistics as residuals while the normalize
-    itself is recomputed.  Parameter/collection tree paths match
-    nn.BatchNorm ('scale', 'bias'; batch_stats 'mean', 'var')."""
+    """nn.BatchNorm (feature-last), bit-identical by construction — the
+    ~15 lines of stat/normalize math are vendored op-for-op from flax
+    (see `_bn_stats`; the normalize below keeps flax's exact operation
+    order: y = x − mean, mul = rsqrt(var + ε) · scale, y·mul + bias) —
+    plus `checkpoint_name` tags on the batch mean/var so the
+    selective-remat policy can keep the statistics as residuals while
+    the normalize itself is recomputed.  Parameter/collection tree
+    paths match nn.BatchNorm ('scale', 'bias'; batch_stats 'mean',
+    'var')."""
     use_running_average: bool = False
     momentum: float = BATCH_NORM_DECAY
     epsilon: float = BATCH_NORM_EPSILON
@@ -74,25 +96,28 @@ class TaggedBatchNorm(nn.Module):
         if self.use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
-            # keywords, not positions: a flax signature change must be
-            # a loud TypeError, never a silent misbinding (sync-BN's
-            # axis_name degrading to per-replica stats would be
-            # invisible to the axis_name=None bit-exactness pin)
-            mean, var = _flax_norm._compute_stats(
-                x, reduction_axes, dtype=self.dtype,
-                axis_name=self.axis_name, axis_index_groups=None)
+            mean, var = _bn_stats(x, reduction_axes, self.dtype,
+                                  self.axis_name)
             mean = checkpoint_name(mean, "bn_stats")
             var = checkpoint_name(var, "bn_stats")
             if not self.is_initializing():
                 m = self.momentum
                 ra_mean.value = m * ra_mean.value + (1 - m) * mean
                 ra_var.value = m * ra_var.value + (1 - m) * var
-        return _flax_norm._normalize(
-            self, x, mean, var, reduction_axes, feature_axes=(-1,),
-            dtype=self.dtype, param_dtype=self.param_dtype,
-            epsilon=self.epsilon, use_bias=True, use_scale=True,
-            bias_init=nn.initializers.zeros_init(),
-            scale_init=nn.initializers.ones_init())
+        # normalize (flax `_normalize`, feature-last + scale&bias case)
+        bshape = (1,) * (x.ndim - 1) + feature_shape
+        y = x - mean.reshape(bshape)
+        mul = lax.rsqrt(var.reshape(bshape) + self.epsilon)
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           feature_shape, self.param_dtype)
+        mul *= scale.reshape(bshape)
+        y *= mul
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          feature_shape, self.param_dtype)
+        y += bias.reshape(bshape)
+        dtype = (jnp.result_type(x, scale, bias) if self.dtype is None
+                 else self.dtype)
+        return jnp.asarray(y, dtype)
 
 
 class Conv1SpaceToDepth(nn.Module):

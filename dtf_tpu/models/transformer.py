@@ -75,18 +75,45 @@ def remat_policy(name: str):
     raise ValueError(f"unknown remat_policy {name!r}; choose 'dots'")
 
 
+def _cached_attention(q, k, v, mask):
+    """Dense attention against a fixed-size KV cache.
+
+    q [B, S, H, Dh] (S = the chunk being decoded), k/v [B, L, H, Dh]
+    (L = the cache capacity), mask [B, S, L] True where the query may
+    attend.  Scores/softmax run in f32 (the flash kernels' accumulator
+    precision); masked positions get a large negative score, and the
+    output is cast back to q's dtype.  At decode shapes (S ∈ {1, P},
+    L fixed) the [S, L] score tile is small — no flash kernel needed."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.float32
     seq_axis: Optional[str] = None   # set when seq dim is mesh-sharded
     model_axis: Optional[str] = None  # set when heads are mesh-sharded
     use_pallas: Any = None           # None=auto; False forces blockwise-JAX
+    # serving: maintain a KV cache ('cache' collection) and attend
+    # incrementally — see TransformerLM.decode
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache_index=None):
         b, s, d = x.shape
         head_dim = d // self.num_heads
         heads = self.num_heads
+        if self.decode and (self.seq_axis is not None
+                            or self.model_axis is not None):
+            # checked before tp_region/psum touch the (unbound) axes
+            raise ValueError(
+                "decode mode (KV cache) is single-device: it does not "
+                "compose with seq_axis/model_axis sharding")
         if self.model_axis is not None:
             x = tp_region(x, self.model_axis)
             # lax.psum of a Python scalar is the static axis size, so
@@ -100,7 +127,46 @@ class CausalSelfAttention(nn.Module):
         qkv = nn.DenseGeneral((3, heads, head_dim), dtype=self.dtype,
                               name="qkv")(x)
         q, k, v = (qkv[..., i, :, :] for i in range(3))  # [B, S, Hloc, Dh]
-        if self.seq_axis is not None:
+        if self.decode:
+            if cache_index is None:
+                raise ValueError("decode mode needs cache_index [B] int32")
+            # cache capacity is fixed by the INIT call's sequence length
+            # (the serving engine initializes with [B, max_seq] dummies);
+            # subsequent applies write their S-token chunk at each row's
+            # cache_index and attend q over the prefix — one code path
+            # for prefill (S = padded prompt) and decode (S = 1)
+            cached_key = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, s, heads, head_dim), k.dtype)
+            cached_value = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, s, heads, head_dim), v.dtype)
+            if not self.is_initializing():
+                max_len = cached_key.value.shape[1]
+
+                def write(cache, new, idx):
+                    return jax.lax.dynamic_update_slice(
+                        cache, new, (idx, 0, 0))
+
+                cached_key.value = jax.vmap(write)(
+                    cached_key.value, k, cache_index)
+                cached_value.value = jax.vmap(write)(
+                    cached_value.value, v, cache_index)
+                # query i (global position idx+i) sees cache slots
+                # j <= idx+i: the just-written chunk causally, the
+                # prefix fully, and never the stale tail beyond idx+i
+                # (overwritten before it can enter the mask)
+                jpos = jnp.arange(max_len)[None, None, :]
+                qpos = (cache_index[:, None, None]
+                        + jnp.arange(s)[None, :, None])
+                o = _cached_attention(q, cached_key.value,
+                                      cached_value.value, jpos <= qpos)
+            else:
+                # init trace: only the cache variables' shapes matter,
+                # but keep the math valid (plain causal attention)
+                o = flash_attention(q, k, v, causal=True,
+                                    use_pallas=self.use_pallas)
+        elif self.seq_axis is not None:
             # sequence-parallel: K/V rotate around the 'seq' ring; every
             # query still attends to the full global sequence
             o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
@@ -130,15 +196,16 @@ class Block(nn.Module):
     seq_axis: Optional[str] = None
     model_axis: Optional[str] = None
     use_pallas: Any = None
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, cache_index=None):
         d = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + CausalSelfAttention(
             self.num_heads, dtype=self.dtype, seq_axis=self.seq_axis,
             model_axis=self.model_axis, use_pallas=self.use_pallas,
-            name="attn")(h)
+            decode=self.decode, name="attn")(h, cache_index)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         d_ff = self.d_ff
         if self.model_axis is not None:
@@ -181,9 +248,16 @@ class TransformerLM(nn.Module):
     # None = save everything jax's autodiff wants (plain remat if
     # `remat`); "dots" = selective remat per the module docstring
     remat_policy: Optional[str] = None
+    # Serving mode (serve/decode.py drives this): every attention keeps
+    # a KV cache in the 'cache' collection, sized by the INIT call's
+    # sequence length, and __call__ takes `cache_index` [B] int32 — the
+    # per-row write offset (each request's current length, which is what
+    # makes slot-based continuous batching possible).  Incompatible with
+    # seq/model sharding and shard_vocab (decode is single-device).
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, cache_index=None):
         del train  # no dropout/BN: LN only, same train/eval behavior
         b, s_local = tokens.shape
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
@@ -193,10 +267,23 @@ class TransformerLM(nn.Module):
         pos_table = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_seq_len, self.d_model))
-        offset = 0
-        if self.seq_axis is not None:
-            offset = jax.lax.axis_index(self.seq_axis) * s_local
-        pos = jax.lax.dynamic_slice_in_dim(pos_table, offset, s_local)
+        if self.decode:
+            if self.shard_vocab:
+                raise ValueError("decode mode does not compose with "
+                                 "shard_vocab (single-device serving)")
+            if cache_index is None:
+                raise ValueError("decode mode needs cache_index [B] int32")
+            # per-row global positions; clamp so a padded prefill chunk
+            # can't index past the table (those rows' logits are unused)
+            pos_idx = jnp.minimum(
+                cache_index[:, None] + jnp.arange(s_local)[None, :],
+                self.max_seq_len - 1)
+            pos = jnp.take(pos_table, pos_idx, axis=0)  # [B, S, d]
+        else:
+            offset = 0
+            if self.seq_axis is not None:
+                offset = jax.lax.axis_index(self.seq_axis) * s_local
+            pos = jax.lax.dynamic_slice_in_dim(pos_table, offset, s_local)
         x = x + pos.astype(self.dtype)
 
         block = Block
@@ -207,7 +294,8 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = block(self.num_heads, self.d_ff, dtype=self.dtype,
                       seq_axis=self.seq_axis, model_axis=self.model_axis,
-                      use_pallas=self.use_pallas, name=f"block{i}")(x)
+                      use_pallas=self.use_pallas, decode=self.decode,
+                      name=f"block{i}")(x, cache_index)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         vocab = self.vocab_size
         if self.shard_vocab and self.model_axis is not None:

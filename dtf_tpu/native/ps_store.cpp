@@ -89,8 +89,14 @@ constexpr uint64_t kMaxParams = 1ull << 32;
 // Snapshot file format (little-endian), shared byte-for-byte with the
 // Python fallback store so either build restores the other's dump:
 //   8-byte magic "DTFPSNP1", u64 version, u64 n,
-//   f32 params[n], f32 velocity[n]
+//   f32 params[n], f32 velocity[n],
+//   then an OPTIONAL footer: 8-byte magic "DTFPSDN1", u64 done_count.
+// The footer persists the DONE tally so a PS restarted after a worker
+// finished and exited cannot hang wait(num_workers) one short; restore
+// accepts footer-less (pre-footer) snapshots with done_count = 0.
 constexpr char kSnapMagic[8] = {'D', 'T', 'F', 'P', 'S', 'N', 'P', '1'};
+constexpr char kSnapFooterMagic[8] = {'D', 'T', 'F', 'P', 'S', 'D', 'N',
+                                      '1'};
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
@@ -439,6 +445,15 @@ void dtf_ps_wait(void* handle, int n_done) {
 // -2 (I/O failure).
 int dtf_ps_snapshot(void* handle, const char* path) {
   auto* s = static_cast<PsServer*>(handle);
+  // done_count is read BEFORE the params copy: a DONE is only sent
+  // after the worker's last push was acked, so any DONE counted here is
+  // already reflected in the params copied below — the reverse order
+  // could persist a "done" worker whose final pushes are missing
+  uint64_t done_count;
+  {
+    std::lock_guard<std::mutex> lk(s->state_mu);
+    done_count = static_cast<uint64_t>(s->done_count);
+  }
   std::vector<float> params, velocity;
   uint64_t version;
   {
@@ -455,7 +470,9 @@ int dtf_ps_snapshot(void* handle, const char* path) {
   bool ok = fwrite(kSnapMagic, 1, 8, f) == 8 &&
             fwrite(&version, 8, 1, f) == 1 && fwrite(&n, 8, 1, f) == 1 &&
             fwrite(params.data(), 4, n, f) == n &&
-            fwrite(velocity.data(), 4, n, f) == n;
+            fwrite(velocity.data(), 4, n, f) == n &&
+            fwrite(kSnapFooterMagic, 1, 8, f) == 8 &&
+            fwrite(&done_count, 8, 1, f) == 1;
   if (ok) ok = fflush(f) == 0 && fsync(fileno(f)) == 0;
   ok = (fclose(f) == 0) && ok;
   if (!ok || rename(tmp.c_str(), path) != 0) {
@@ -490,15 +507,32 @@ int dtf_ps_restore(void* handle, const char* path) {
   }
   if (ok)
     ok = fread(params.data(), 4, n, f) == n &&
-         fread(velocity.data(), 4, n, f) == n &&
-         fgetc(f) == EOF;  // no trailing garbage
+         fread(velocity.data(), 4, n, f) == n;
+  uint64_t done_count = 0;  // footer-less (pre-footer) snapshots: 0
+  if (ok) {
+    char footer_magic[8];
+    const size_t got = fread(footer_magic, 1, 8, f);
+    if (got == 8) {
+      ok = memcmp(footer_magic, kSnapFooterMagic, 8) == 0 &&
+           fread(&done_count, 8, 1, f) == 1 && fgetc(f) == EOF;
+    } else {
+      ok = got == 0 && feof(f);  // no footer: clean EOF required
+    }
+  }
   fclose(f);
   if (!ok) return -2;
-  std::lock_guard<std::mutex> lk(s->mu);
-  s->params = std::move(params);
-  s->velocity = std::move(velocity);
-  s->version = version;
-  s->initialized = true;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->params = std::move(params);
+    s->velocity = std::move(velocity);
+    s->version = version;
+    s->initialized = true;
+  }
+  {
+    std::lock_guard<std::mutex> lk(s->state_mu);
+    s->done_count = static_cast<int>(done_count);
+  }
+  s->cv.notify_all();
   return 0;
 }
 

@@ -127,8 +127,23 @@ class StaleNativeLib(OSError):
 
 # Snapshot file format (little-endian), byte-identical between the C++
 # and Python stores: 8-byte magic, u64 version, u64 n, f32 params[n],
-# f32 velocity[n].  Written atomically (tmp + rename).
+# f32 velocity[n], then an OPTIONAL footer — 8-byte footer magic + u64
+# done_count.  Written atomically (tmp + rename).  The footer carries
+# the DONE tally so a PS restart after a worker has delivered DONE and
+# exited cannot hang wait(num_workers) one short (ADVICE r5); restore
+# accepts footer-less (pre-footer) snapshots with done_count = 0.
 SNAP_MAGIC = b"DTFPSNP1"
+SNAP_FOOTER_MAGIC = b"DTFPSDN1"
+
+# Reconnect-reseed guard floor (see PsClient): with fewer than this
+# many versions seen, a reconnecting worker may still re-seed an
+# uninitialized restarted store — the legitimate pre-first-snapshot
+# crash window is ~1 s of cluster pushes (the fast first dump), which
+# this bounds generously.  Beyond it the tolerance scales with the
+# versions actually seen, so a short run can no longer silently
+# discard its whole history just because it stayed under the static
+# tolerance (ADVICE r5).
+RESEED_ABS_FLOOR = 64
 
 # The ONE copy of the reseed-guard default (config.flags imports it for
 # --ps_reseed_tolerance): how many store versions a restarted PS may
@@ -391,7 +406,17 @@ class _PyPsServer:
 
     def snapshot(self, path: str):
         """Same atomic dump + file format as dtf_ps_snapshot (the C++
-        store) — either build restores the other's snapshot."""
+        store) — either build restores the other's snapshot.  The
+        done_count footer makes the DONE tally restart-durable (a
+        crashed PS whose workers already finished must not hang
+        wait(num_workers) one short after restore)."""
+        # done_count is read BEFORE the params copy: a DONE is only sent
+        # after the worker's last push was acked, so any DONE counted
+        # here is already reflected in the params we then copy — the
+        # reverse order could persist a "done" worker whose final pushes
+        # are missing from the saved state
+        with self.state:
+            done_count = self.done_count
         with self.mu:
             if self.params is None:
                 raise ValueError("snapshot: store not initialized")
@@ -404,6 +429,8 @@ class _PyPsServer:
             f.write(struct.pack("<QQ", version, params.size))
             f.write(params.astype("<f4", copy=False).tobytes())
             f.write(velocity.astype("<f4", copy=False).tobytes())
+            f.write(SNAP_FOOTER_MAGIC)
+            f.write(struct.pack("<Q", done_count))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -414,9 +441,16 @@ class _PyPsServer:
         if len(data) < 24 or data[:8] != SNAP_MAGIC:
             raise OSError(f"restore from {path!r} failed: bad magic")
         version, n = struct.unpack("<QQ", data[8:24])
-        if n == 0 or n > MAX_PARAMS or len(data) != 24 + 8 * n:
+        base = 24 + 8 * n
+        if n == 0 or n > MAX_PARAMS or len(data) not in (base, base + 16):
             raise OSError(f"restore from {path!r} failed: corrupt or "
                           f"truncated snapshot")
+        done_count = 0  # footer-less (pre-footer) snapshots restore as 0
+        if len(data) == base + 16:
+            if data[base:base + 8] != SNAP_FOOTER_MAGIC:
+                raise OSError(f"restore from {path!r} failed: corrupt "
+                              f"footer")
+            (done_count,) = struct.unpack("<Q", data[base + 8:base + 16])
         params = np.frombuffer(data, "<f4", count=n, offset=24).copy()
         velocity = np.frombuffer(data, "<f4", count=n,
                                  offset=24 + 4 * n).copy()
@@ -424,6 +458,9 @@ class _PyPsServer:
             self.params = params
             self.velocity = velocity
             self.version = version
+        with self.state:
+            self.done_count = int(done_count)
+            self.state.notify_all()
 
     def stop(self):
         """Mirror the native dtf_ps_stop: stop accepting, tear down live
@@ -566,15 +603,27 @@ class PsClient:
                         st, _, ver = struct.unpack(
                             "<BQQ", _recvn(self.sock, 17))
                         lost = self._last_version - ver
-                        if lost > self.reseed_tolerance:
+                        # effective tolerance scales with the history
+                        # this client actually saw: a short run (total
+                        # pushes far under the static tolerance) must
+                        # not silently discard its entire progress just
+                        # because the loss fits the 10k default — only
+                        # losses plausible for the pre-first-snapshot
+                        # window (RESEED_ABS_FLOOR) or a bounded
+                        # fraction of the seen history pass
+                        effective = min(
+                            self.reseed_tolerance,
+                            max(RESEED_ABS_FLOOR, self._last_version // 2))
+                        if lost > effective:
                             raise RuntimeError(
                                 f"restarted parameter store is at "
                                 f"version {ver} but this worker already "
-                                f"saw {self._last_version} — the store "
-                                f"lost the run's state (missing/corrupt "
-                                f"snapshot?).  Refusing to continue "
-                                f"mid-schedule from near-initial "
-                                f"params; restart the job")
+                                f"saw {self._last_version} (effective "
+                                f"reseed tolerance {effective}) — the "
+                                f"store lost the run's state (missing/"
+                                f"corrupt snapshot?).  Refusing to "
+                                f"continue mid-schedule from "
+                                f"near-initial params; restart the job")
                         if st == 2:
                             # uninitialized AND within tolerance: the
                             # pre-first-dump crash window — re-seed
@@ -678,7 +727,12 @@ class PsClient:
     def info(self) -> Tuple[int, int, int]:
         def once():
             self.sock.sendall(bytes([OP_INFO]))
-            return struct.unpack("<BQQ", _recvn(self.sock, 17))
+            st, n, ver = struct.unpack("<BQQ", _recvn(self.sock, 17))
+            # keep the reconnect reseed guard's baseline fresh: a client
+            # whose latest traffic was info() must not under-detect a
+            # store that lost the run (ADVICE r5)
+            self._last_version = max(self._last_version, ver)
+            return st, n, ver
 
         return self._retrying("info", once)
 

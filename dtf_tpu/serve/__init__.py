@@ -1,0 +1,12 @@
+"""Serving subsystem: checkpoint→inference bridge, KV-cache decode,
+dynamic batching engine (see docs in each module)."""
+
+from dtf_tpu.serve.bridge import (load_for_serving,       # noqa: F401
+                                  load_inference_variables,
+                                  place_for_serving)
+from dtf_tpu.serve.decode import (Decoder, init_cache,    # noqa: F401
+                                  make_decode_model,
+                                  teacher_forced_logits)
+from dtf_tpu.serve.engine import (Backpressure,           # noqa: F401
+                                  ServeEngine, ServeRequest, ServeResult)
+from dtf_tpu.serve.metrics import ServingStats, collect_stats  # noqa: F401

@@ -1,0 +1,78 @@
+"""Checkpoint → serving bridge.
+
+Train-side state comes in two on-disk shapes (train/checkpoint.py):
+
+  <model_dir>/checkpoints/<step>/  — the full TrainState (params,
+      batch_stats, optimizer state, step) written by the per-epoch
+      CheckpointCallback via orbax CheckpointManager
+  <export_dir>/model/              — inference variables only
+      (params + batch_stats), the --export_dir SavedModel equivalent
+
+Serving needs neither optimizer state nor the step counter, and it
+needs FULL (un-sharded) parameter arrays on the serving device.  Both
+come out of orbax as host-global arrays regardless of how the run was
+sharded — a ZeRO run (--optimizer_sharding) slices only its *optimizer*
+state across 'data', and a TP/EP/PP run's params are saved as global
+arrays with per-leaf shardings — so the re-gather is: restore the
+global view, drop everything but params/batch_stats, and device_put the
+result with the replicated sharding of a fresh serving mesh
+(runtime/mesh.py ``make_mesh`` + ``NamedSharding(mesh, P())``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("dtf_tpu")
+
+
+def load_inference_variables(model_dir: str = "", export_dir: str = "",
+                             step: Optional[int] = None) -> dict:
+    """Load {"params": ..., "batch_stats": ...} from a train checkpoint
+    (``model_dir``) or an exported model (``export_dir``).
+
+    ``export_dir`` wins when both are given (it is the purpose-built
+    inference artifact).  ``step`` selects a specific train checkpoint;
+    None = latest.  Raises FileNotFoundError when neither location has
+    a restorable checkpoint — serving random init would silently answer
+    garbage, which is strictly worse than failing."""
+    if export_dir and os.path.isdir(os.path.join(
+            os.path.abspath(export_dir), "model")):
+        from dtf_tpu.train.checkpoint import load_exported_model
+        payload = load_exported_model(export_dir)
+        log.info("serve bridge: loaded exported model from %s", export_dir)
+        return {"params": payload["params"],
+                "batch_stats": payload.get("batch_stats", {})}
+    if model_dir:
+        from dtf_tpu.train.checkpoint import load_train_checkpoint
+        payload = load_train_checkpoint(model_dir, step=step)
+        if payload is not None:
+            return payload
+    raise FileNotFoundError(
+        f"no checkpoint to serve: export_dir={export_dir!r} has no "
+        f"model/, model_dir={model_dir!r} has no checkpoints/")
+
+
+def place_for_serving(variables, devices=None):
+    """Re-gather + place: put the (host-global) inference variables on
+    the serving mesh, fully replicated — the broadcast half of the
+    restore-then-rebroadcast checkpoint contract, reused for serving."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dtf_tpu.runtime.mesh import make_mesh
+
+    devices = list(devices if devices is not None else jax.devices()[:1])
+    mesh = make_mesh(devices, data=1, seq=1, model=1)
+    return jax.device_put(variables, NamedSharding(mesh, P()))
+
+
+def load_for_serving(model_dir: str = "", export_dir: str = "",
+                     step: Optional[int] = None, devices=None) -> dict:
+    """One-call bridge: restore + re-gather + place."""
+    return place_for_serving(
+        load_inference_variables(model_dir, export_dir, step=step),
+        devices=devices)

@@ -1,0 +1,161 @@
+"""KV-cache incremental decoding for the transformer LM.
+
+The training forward is teacher-forced: logits for every position in
+one pass.  Serving needs the autoregressive form — one new token per
+step — without recomputing the whole prefix.  The model side lives in
+``models/transformer.py`` (``decode=True``: every attention keeps
+``cached_key``/``cached_value`` in the 'cache' collection and takes a
+per-row ``cache_index``); this module owns the jit-compiled step
+functions around it:
+
+  - ``init_cache``      — zeros cache pytree with fixed [B, L] shapes
+  - ``prefill``         — write one padded prompt into one cache slot and
+                          sample the first generated token
+  - ``decode_step``     — one token for every slot in the batch
+  - ``teacher_forced_logits`` — the training-style forward, the oracle
+                          the decode path is verified token-exact against
+
+Everything is shaped for slot-based continuous batching: the cache is
+[num_slots, max_seq_len, H, Dh] per layer, ``cache_index`` is [B], and
+both step functions compile ONCE (fixed shapes; scalars like the slot id
+and prompt length are traced arrays, never Python ints).
+
+Sampling: greedy when temperature == 0, else softmax sampling at
+``logits / temperature`` — per-row, so one batch can mix both.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_decode_model(model):
+    """Clone a (training-configured) TransformerLM into decode mode.
+
+    Sharding attributes are stripped: decode is single-device (the
+    bridge re-gathers sharded checkpoints into full params first)."""
+    kw = {"decode": True}
+    for attr in ("seq_axis", "model_axis"):
+        if getattr(model, attr, None) is not None:
+            kw[attr] = None
+    if getattr(model, "shard_vocab", False):
+        kw["shard_vocab"] = False
+    return model.clone(**kw)
+
+
+def init_cache(model, num_slots: int, max_seq_len: int):
+    """Zeros KV cache for ``num_slots`` sequences of ≤ ``max_seq_len``
+    tokens.  Shapes come from an eval_shape of the decode model's init
+    (no params are materialized); values are zeros by construction."""
+    decode_model = make_decode_model(model)
+    tokens = jax.ShapeDtypeStruct((num_slots, max_seq_len), jnp.int32)
+    idx = jax.ShapeDtypeStruct((num_slots,), jnp.int32)
+    shapes = jax.eval_shape(
+        functools.partial(decode_model.init, jax.random.key(0)),
+        tokens, cache_index=idx)["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
+def _sample(logits, temperature, key):
+    """logits [..., V] → token ids [...]; greedy at temperature 0."""
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(
+        key, logits / safe_t[..., None], axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+class Decoder:
+    """Jitted prefill/decode pair bound to one model + param set.
+
+    ``params`` may include 'batch_stats' siblings conceptually, but the
+    LM family is LN-only — only 'params' is applied."""
+
+    def __init__(self, model, params, *, num_slots: int, max_seq_len: int):
+        self.model = make_decode_model(model)
+        self.params = params
+        self.num_slots = int(num_slots)
+        self.max_seq_len = int(max_seq_len)
+        if getattr(model, "max_seq_len", max_seq_len) < max_seq_len:
+            raise ValueError(
+                f"max_seq_len {max_seq_len} exceeds the model's position "
+                f"table ({model.max_seq_len})")
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+
+    def fresh_cache(self):
+        return init_cache(self.model, self.num_slots, self.max_seq_len)
+
+    # -- jitted bodies -------------------------------------------------
+    def _prefill_impl(self, params, cache, tokens, slot, length,
+                      temperature, key):
+        """tokens [1, max_seq_len] (prompt padded with zeros), slot/
+        length scalar arrays.  Writes the slot's cache row, returns
+        (first generated token scalar, new cache, last-position logits).
+        """
+        row = jax.tree_util.tree_map(
+            lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0),
+            cache)
+        logits, mut = self.model.apply(
+            {"params": params, "cache": row}, tokens,
+            cache_index=jnp.zeros((1,), jnp.int32), mutable=["cache"])
+        cache = jax.tree_util.tree_map(
+            lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                c, r, slot, axis=0),
+            cache, mut["cache"])
+        # next token comes from the last REAL prompt position
+        last = jax.lax.dynamic_slice_in_dim(
+            logits[0], length - 1, 1, axis=0)[0]          # [V]
+        tok = _sample(last, temperature, key)
+        return tok, cache, last
+
+    def _decode_impl(self, params, cache, tokens, index, temperature, key):
+        """tokens [B, 1] (the previous step's output per slot), index [B]
+        current lengths, temperature [B].  One step for every slot —
+        inactive slots decode garbage that the engine ignores."""
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, tokens,
+            cache_index=index, mutable=["cache"])
+        last = logits[:, -1]                               # [B, V]
+        keys = jax.random.split(key, last.shape[0])
+        toks = jax.vmap(_sample)(last, temperature, keys)
+        return toks, mut["cache"], last
+
+    # -- public API ----------------------------------------------------
+    def prefill(self, cache, prompt, slot: int, temperature: float,
+                key) -> Tuple[Any, Any, Any]:
+        """prompt: 1-D int32 (unpadded).  Returns (token, cache, logits)
+        with the first sampled token as a device scalar."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.shape[0] == 0:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        length = int(prompt.shape[0])
+        if length > self.max_seq_len:
+            raise ValueError(
+                f"prompt length {length} exceeds max_seq_len "
+                f"{self.max_seq_len}")
+        padded = np.zeros((1, self.max_seq_len), np.int32)
+        padded[0, :length] = prompt
+        return self._prefill(self.params, cache, jnp.asarray(padded),
+                             jnp.asarray(slot, jnp.int32),
+                             jnp.asarray(length, jnp.int32),
+                             jnp.asarray(temperature, jnp.float32), key)
+
+    def decode_step(self, cache, tokens, index, temperature, key):
+        """tokens [B], index [B], temperature [B] → (tokens [B], cache,
+        logits [B, V])."""
+        return self._decode(self.params, cache,
+                            jnp.asarray(tokens, jnp.int32).reshape(-1, 1),
+                            jnp.asarray(index, jnp.int32),
+                            jnp.asarray(temperature, jnp.float32), key)
+
+
+def teacher_forced_logits(model, params, tokens):
+    """The training-style full forward — the decode path's oracle."""
+    return model.apply({"params": params}, jnp.asarray(tokens, jnp.int32))

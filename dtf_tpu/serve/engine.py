@@ -1,0 +1,338 @@
+"""Dynamic batching engine: request queue → slot-based continuous
+batching over the KV-cache decoder.
+
+Serving traffic is many small requests arriving at random times;
+accelerators want big fixed-shape batches.  The engine bridges the two
+with the standard production recipe:
+
+  admission control — ``submit`` validates size up front: a request
+      whose prompt + budget cannot fit the cache is rejected loudly
+      (ValueError) instead of being admitted and truncated silently.
+  backpressure      — the queue is bounded.  A full queue sheds the
+      request with :class:`Backpressure` carrying ``retry_after``
+      (an EWMA-based estimate), and logs the shed — "loud shed":
+      capacity problems must be visible, never silent latency.
+  max-batch / max-delay — a fresh batch waits up to ``max_delay_s``
+      after the first arrival to fill up to ``max_batch`` slots, then
+      goes; once decoding, new arrivals join at any step boundary.
+  continuous batching — the decode step always runs the full
+      [num_slots, 1] shape (compiled exactly once); each slot carries
+      its own ``cache_index``, so sequences of different lengths
+      coexist, finish independently, and free their slot for the next
+      queued request without draining the batch.
+
+Single engine thread owns ALL device work (prefill, decode, sampling);
+``submit`` only enqueues — so there is no cross-thread jit contention.
+Each decode step syncs the sampled tokens to the host (the EOS/budget
+check needs them); at CPU/test scale this is negligible, on a real TPU
+serving stack the next optimization would be a lookahead pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from dtf_tpu.serve.decode import Decoder
+
+log = logging.getLogger("dtf_tpu")
+
+
+class Backpressure(RuntimeError):
+    """Request shed: the queue is full.  ``retry_after`` (seconds) is
+    the engine's estimate of when capacity frees up."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(
+            f"serving queue full — shed; retry after {retry_after:.2f}s")
+        self.retry_after = retry_after
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    prompt: np.ndarray                  # 1-D int32 token ids
+    max_new_tokens: int = 32
+    temperature: float = 0.0            # 0 = greedy
+    eos_id: Optional[int] = None        # stop token (included in output)
+    # filled by the engine
+    id: int = -1
+    submit_time: float = 0.0
+    admit_time: float = 0.0
+    first_token_time: float = 0.0
+    finish_time: float = 0.0
+
+
+@dataclasses.dataclass
+class ServeResult:
+    request_id: int
+    tokens: List[int]                   # generated tokens (prompt excluded)
+    prompt_len: int
+    queue_wait_s: float
+    time_to_first_token_s: float
+    latency_s: float
+    # absolute timestamps (time.time()), so metrics can reconstruct the
+    # serving window across requests without trusting the caller
+    submit_time: float = 0.0
+    finish_time: float = 0.0
+    cancelled: bool = False
+
+
+class _Handle:
+    """Future-lite returned by submit()."""
+
+    def __init__(self, req: ServeRequest):
+        self.request = req
+        self._event = threading.Event()
+        self._result: Optional[ServeResult] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.id} not finished in {timeout}s")
+        return self._result
+
+    def _deliver(self, result: ServeResult):
+        self._result = result
+        self._event.set()
+
+
+@dataclasses.dataclass
+class _Slot:
+    handle: _Handle
+    tokens: List[int]                   # generated so far
+    last_token: int                     # next decode step's input
+    index: int                          # current sequence length
+
+
+class ServeEngine:
+    """Dynamic batcher over a :class:`~dtf_tpu.serve.decode.Decoder`.
+
+    ``model`` is a TransformerLM (training configuration); ``params``
+    its param pytree (from serve.bridge).  ``max_seq_len`` bounds
+    prompt + generation per request and fixes the cache shapes."""
+
+    def __init__(self, model, params, *, max_batch: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 max_delay_s: float = 0.005, queue_size: int = 64,
+                 seed: int = 0):
+        if max_batch < 1 or queue_size < 1:
+            raise ValueError("max_batch and queue_size must be >= 1")
+        self.max_batch = int(max_batch)
+        self.max_seq_len = int(max_seq_len or model.max_seq_len)
+        self.max_delay_s = float(max_delay_s)
+        self.queue_size = int(queue_size)
+        self.decoder = Decoder(model, params, num_slots=self.max_batch,
+                               max_seq_len=self.max_seq_len)
+        self._cache = self.decoder.fresh_cache()
+        self._key = jax.random.key(seed)
+
+        self._cond = threading.Condition()
+        self._pending: List[_Handle] = []
+        self._slots: List[Optional[_Slot]] = [None] * self.max_batch
+        self._stop = threading.Event()
+        self._ids = itertools.count()
+        # metrics
+        self.completed: List[ServeResult] = []
+        self.shed_count = 0
+        self._ewma_latency = 0.25       # seed estimate for retry_after
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-engine")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0,
+               eos_id: Optional[int] = None) -> _Handle:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        total = int(prompt.size) + int(max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"oversized request: prompt ({prompt.size}) + "
+                f"max_new_tokens ({max_new_tokens}) = {total} exceeds "
+                f"max_seq_len {self.max_seq_len}; shorten the prompt or "
+                f"lower the budget")
+        req = ServeRequest(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                           temperature=float(temperature), eos_id=eos_id)
+        handle = _Handle(req)
+        with self._cond:
+            # checked under the lock: a submit racing stop() must either
+            # land in _pending BEFORE the stop (and get drained or
+            # cancelled there) or raise here — never enqueue onto a
+            # stopped engine, where nothing would ever deliver it
+            if self._stop.is_set():
+                raise RuntimeError("engine is stopped")
+            if len(self._pending) >= self.queue_size:
+                self.shed_count += 1
+                retry = max(0.05, self._ewma_latency
+                            * (1 + len(self._pending) / self.max_batch))
+                log.error(
+                    "serve: queue full (%d pending, %d slots) — shedding "
+                    "request (%d total shed); retry_after=%.2fs",
+                    len(self._pending), self.max_batch, self.shed_count,
+                    retry)
+                raise Backpressure(retry)
+            req.id = next(self._ids)
+            req.submit_time = time.time()
+            self._pending.append(handle)
+            self._cond.notify_all()
+        return handle
+
+    def generate(self, prompt, **kw) -> ServeResult:
+        """Blocking convenience: submit + wait."""
+        return self.submit(prompt, **kw).result(timeout=600)
+
+    # -- engine thread -------------------------------------------------
+    def _loop(self):
+        try:
+            self._loop_body()
+        except Exception:
+            # a dead engine thread must not strand clients blocked in
+            # result(): fail loudly and deliver cancellations
+            log.exception("serve engine thread died — cancelling all "
+                          "in-flight and queued requests")
+            with self._cond:
+                self._stop.set()
+                stranded = ([s.handle for s in self._slots
+                             if s is not None] + list(self._pending))
+                self._slots = [None] * self.max_batch
+                self._pending.clear()
+            for handle in stranded:
+                req = handle.request
+                handle._deliver(ServeResult(
+                    request_id=req.id, tokens=[], prompt_len=0,
+                    queue_wait_s=0.0, time_to_first_token_s=0.0,
+                    latency_s=0.0, cancelled=True))
+
+    def _loop_body(self):
+        while True:
+            with self._cond:
+                active = any(s is not None for s in self._slots)
+                if not self._pending and not active:
+                    if self._stop.is_set():
+                        return
+                    # empty queue: sleep until a submit (or stop) pokes us
+                    self._cond.wait(timeout=0.1)
+                    continue
+                if not active and self._pending and self.max_delay_s > 0:
+                    # fresh batch: hold the door up to max_delay after the
+                    # FIRST pending arrival so the batch can fill
+                    first = self._pending[0].request.submit_time
+                    while (len(self._pending) < self.max_batch
+                           and not self._stop.is_set()):
+                        remaining = first + self.max_delay_s - time.time()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
+                admitted = []
+                for i, slot in enumerate(self._slots):
+                    if slot is None and self._pending:
+                        admitted.append((i, self._pending.pop(0)))
+            if self._stop.is_set() and not any(
+                    s is not None for s in self._slots) and not admitted:
+                return
+            for i, handle in admitted:
+                self._admit(i, handle)
+            if any(s is not None for s in self._slots):
+                self._step()
+
+    def _admit(self, slot_idx: int, handle: _Handle):
+        req = handle.request
+        req.admit_time = time.time()
+        self._key, sub = jax.random.split(self._key)
+        tok, self._cache, _ = self.decoder.prefill(
+            self._cache, req.prompt, slot_idx, req.temperature, sub)
+        first = int(tok)
+        req.first_token_time = time.time()
+        slot = _Slot(handle=handle, tokens=[first], last_token=first,
+                     index=int(req.prompt.size))
+        self._slots[slot_idx] = slot
+        if self._finished(slot):
+            self._retire(slot_idx)
+
+    def _step(self):
+        tokens = np.zeros((self.max_batch,), np.int32)
+        index = np.zeros((self.max_batch,), np.int32)
+        temps = np.zeros((self.max_batch,), np.float32)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                tokens[i] = s.last_token
+                index[i] = s.index
+                temps[i] = s.handle.request.temperature
+        self._key, sub = jax.random.split(self._key)
+        out, self._cache, _ = self.decoder.decode_step(
+            self._cache, tokens, index, temps, sub)
+        out = np.asarray(out)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tok = int(out[i])
+            s.tokens.append(tok)
+            s.last_token = tok
+            s.index += 1
+            if self._finished(s):
+                self._retire(i)
+
+    @staticmethod
+    def _finished(slot: _Slot) -> bool:
+        req = slot.handle.request
+        return (len(slot.tokens) >= req.max_new_tokens
+                or (req.eos_id is not None
+                    and slot.tokens[-1] == req.eos_id))
+
+    def _retire(self, slot_idx: int):
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        req = slot.handle.request
+        req.finish_time = time.time()
+        result = ServeResult(
+            request_id=req.id,
+            tokens=list(slot.tokens),
+            prompt_len=int(req.prompt.size),
+            queue_wait_s=req.admit_time - req.submit_time,
+            time_to_first_token_s=req.first_token_time - req.submit_time,
+            latency_s=req.finish_time - req.submit_time,
+            submit_time=req.submit_time, finish_time=req.finish_time)
+        self._ewma_latency = (0.8 * self._ewma_latency
+                              + 0.2 * result.latency_s)
+        self.completed.append(result)
+        slot.handle._deliver(result)
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- lifecycle -----------------------------------------------------
+    def stop(self, drain: bool = True, timeout: float = 60.0):
+        """Stop the engine.  ``drain=True`` finishes in-flight AND
+        already-queued work first; False cancels queued requests."""
+        with self._cond:
+            if not drain:
+                for handle in self._pending:
+                    req = handle.request
+                    handle._deliver(ServeResult(
+                        request_id=req.id, tokens=[], prompt_len=0,
+                        queue_wait_s=0.0, time_to_first_token_s=0.0,
+                        latency_s=0.0, cancelled=True))
+                self._pending.clear()
+            self._stop.set()
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=False)

@@ -83,6 +83,37 @@ def export_model(export_dir: str, state) -> str:
     return path
 
 
+def load_train_checkpoint(model_dir: str, step: Optional[int] = None):
+    """Load-for-inference: restore a train-format checkpoint written by
+    :class:`Checkpointer` WITHOUT knowing the TrainState structure, and
+    return only ``{"params", "batch_stats"}`` (host-global arrays).
+
+    The restore is structure-free (orbax rebuilds the pytree from the
+    checkpoint's own metadata), so a serving process does not need the
+    training run's optimizer/loss-scale configuration — including
+    ZeRO-sharded runs, whose sliced optimizer state is simply dropped.
+    Returns None when ``model_dir`` has no checkpoint."""
+    directory = os.path.abspath(os.path.join(model_dir, "checkpoints"))
+    if not os.path.isdir(directory):
+        return None
+    mgr = ocp.CheckpointManager(directory)
+    try:
+        step = mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        restored = mgr.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        mgr.close()
+    if not isinstance(restored, dict) or "params" not in restored:
+        raise ValueError(
+            f"checkpoint at {directory} step {step} is not a TrainState "
+            f"(keys: {sorted(restored) if isinstance(restored, dict) else type(restored)})")
+    log.info("serve bridge: loaded train checkpoint step %s from %s",
+             step, directory)
+    return {"params": restored["params"],
+            "batch_stats": restored.get("batch_stats") or {}}
+
+
 def load_exported_model(export_dir: str) -> dict:
     """Restore variables written by `export_model` (for serving/tests)."""
     path = os.path.abspath(os.path.join(export_dir, "model"))

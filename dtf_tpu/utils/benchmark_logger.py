@@ -94,6 +94,12 @@ class BenchmarkFileLogger:
             if key in stats and stats[key] is not None:
                 self.log_metric(key, stats[key], global_step=global_step)
 
+    def log_serving_stats(self, serving_stats) -> None:
+        """Record a serving run (serve.metrics.ServingStats) in the same
+        metric.log format — one line per latency/throughput metric."""
+        for rec in serving_stats.to_metrics():
+            self.log_metric(rec["name"], rec["value"], unit=rec["unit"])
+
 
 def _jsonable(obj):
     try:

@@ -705,3 +705,116 @@ def test_three_process_async_ps(tmp_path):
         assert m, f"no final loss in worker {i} log:\n{text[-2000:]}"
         losses.append(float(m.group(1)))
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_snapshot_persists_done_count(server, tmp_path):
+    """PS snapshot durability for the DONE tally (ADVICE r5): a worker
+    that reported DONE and EXITED before a PS crash must still count on
+    the restarted rank — wait(num_workers) on the restored store
+    returns without that worker ever coming back."""
+    path = str(tmp_path / "s.snap")
+    client = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    client.init(np.ones(3, np.float32))
+    client.done()       # worker finishes...
+    client.close()      # ...and exits for good
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            server.snapshot(path)
+            break
+        except ValueError:
+            time.sleep(0.05)
+    server.stop()       # PS crashes after the DONE landed
+
+    srv2 = ps_lib.PsServer(port=0)
+    try:
+        srv2.restore(path)
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (srv2.wait(1), done.set()))
+        t.start()
+        assert done.wait(10), (
+            "restored store lost the DONE tally: wait(1) hangs")
+        t.join()
+    finally:
+        srv2.stop()
+
+
+def test_restore_accepts_footerless_snapshot(server, tmp_path):
+    """Pre-footer snapshots (no done_count) still restore, with the
+    tally at 0 — a rolling upgrade must not quarantine good dumps."""
+    import struct
+    path = str(tmp_path / "old.snap")
+    params = np.asarray([1.0, 2.0], np.float32)
+    velocity = np.zeros(2, np.float32)
+    with open(path, "wb") as f:
+        f.write(ps_lib.SNAP_MAGIC)
+        f.write(struct.pack("<QQ", 5, 2))
+        f.write(params.tobytes())
+        f.write(velocity.tobytes())
+    server.restore(path)
+    client = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    ver, flat = client.pull()
+    assert ver == 5
+    np.testing.assert_array_equal(flat, params)
+    client.close()
+
+
+def test_info_updates_last_version(server):
+    """info() must advance the client's _last_version baseline (ADVICE
+    r5): a client whose latest traffic was info() would otherwise carry
+    a stale baseline into the reconnect reseed guard."""
+    c1 = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    c1.init(np.zeros(2, np.float32))
+    for _ in range(5):
+        c1.push(0.1, np.ones(2, np.float32))
+    c2 = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    assert c2._last_version == 0
+    st, n, ver = c2.info()
+    assert (st, n, ver) == (0, 2, 5)
+    assert c2._last_version == 5
+    c1.close()
+    c2.close()
+
+
+def test_reseed_tolerance_scales_with_history():
+    """A SHORT run (total pushes under the static 10k tolerance but
+    past the absolute floor) must refuse to re-seed a restarted store
+    that lost everything — the pre-r6 behavior silently discarded up
+    to 10k pushes of progress (ADVICE r5)."""
+    srv = ps_lib.PsServer(port=0)
+    port = srv.port
+    client = ps_lib.PsClient(f"127.0.0.1:{port}", reconnect_timeout=20.0)
+    assert client.reseed_tolerance == ps_lib.DEFAULT_RESEED_TOLERANCE
+    client.init(np.zeros(4, np.float32))
+    g = np.ones(4, np.float32)
+    n_push = 3 * ps_lib.RESEED_ABS_FLOOR  # well under 10k, over the floor
+    for _ in range(n_push):
+        client.push(0.01, g)
+    srv.stop()  # crash with NO snapshot
+    srv2 = ps_lib.PsServer(port=port)  # restart, empty
+    try:
+        with pytest.raises(RuntimeError, match="lost the run"):
+            client.push(0.01, g)
+    finally:
+        client.close()
+        srv2.stop()
+
+
+def test_reseed_still_allowed_in_early_window():
+    """Under the absolute floor (the legitimate pre-first-snapshot
+    crash window) a reconnecting worker still re-seeds and survives."""
+    srv = ps_lib.PsServer(port=0)
+    port = srv.port
+    client = ps_lib.PsClient(f"127.0.0.1:{port}", reconnect_timeout=20.0)
+    client.init(np.zeros(4, np.float32))
+    g = np.ones(4, np.float32)
+    for _ in range(ps_lib.RESEED_ABS_FLOOR // 2):  # a few early pushes
+        client.push(0.01, g)
+    srv.stop()
+    srv2 = ps_lib.PsServer(port=port)  # restart, empty (no snapshot yet)
+    try:
+        ver = client.push(0.01, g)  # re-seeds, then applies
+        assert ver >= 1
+    finally:
+        client.close()
+        srv2.stop()
