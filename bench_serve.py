@@ -123,6 +123,17 @@ def main():
     _jline("serve_latency_p50", s.latency_p50_s, "s")
     _jline("serve_latency_p99", s.latency_p99_s, "s")
     _jline("serve_ttft_p50", s.ttft_p50_s, "s")
+    # engine registry (obs.MetricsRegistry): operational signals that
+    # used to be log lines at best — shed total, queue depth, slot
+    # occupancy sampled per decode iteration
+    shed = eng.metrics.get("serve_shed_total")
+    occ = eng.metrics.get("serve_slot_occupancy_sampled").snapshot()
+    qd = eng.metrics.get("serve_queue_depth_sampled").snapshot()
+    _jline("serve_shed_total", shed.value, "requests")
+    _jline("serve_slot_occupancy_mean", occ["mean"], "fraction",
+           p90=round(occ["p90"], 4), samples=occ["count"])
+    _jline("serve_queue_depth_p90", qd["p90"], "requests",
+           max=qd["max"], mean=round(qd["mean"], 4))
     if ratio < 2.0:
         raise SystemExit(
             f"batched decode speedup {ratio:.2f}x is below the 2x bar")

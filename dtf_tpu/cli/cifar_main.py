@@ -37,6 +37,7 @@ def main(argv=None) -> dict:
         format="%(asctime)s %(name)s %(levelname)s: %(message)s")
     cfg = parse_flags(argv if argv is not None else sys.argv[1:],
                       defaults=CIFAR_DEFAULTS)
+    # --trace_dir / DTF_TRACE_DIR tracing is configured by run() itself
     return run(cfg)
 
 

@@ -28,6 +28,7 @@ launcher exits non-zero.
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import signal
@@ -36,13 +37,42 @@ import sys
 import time
 from typing import List, Optional
 
+# Heartbeat-file contract, duplicated from dtf_tpu/obs/watchdog.py ON
+# PURPOSE: the supervisor's own logic stays stdlib-only — the process
+# that kills and restarts broken ML ranks should not depend on the obs
+# package it supervises (the unavoidable cost of `-m dtf_tpu.cli.launch`
+# is the package-init shard_map shim's jax import, a fixed ~3 s).
+# tests/test_obs.py asserts the two sides agree on the contract.
+HEARTBEAT_DIR_ENV = "DTF_HEARTBEAT_DIR"
+
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"heartbeat_rank{rank}.json")
+
+
+def read_heartbeat(path: str):
+    """Parse a heartbeat file; None when missing/torn (treated as 'no
+    heartbeat signal', not as death — log growth still counts)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
 
 def build_env(rank: int, world: int, coordinator: str,
-              devices_per_process: Optional[int] = None) -> dict:
+              devices_per_process: Optional[int] = None,
+              heartbeat_dir: Optional[str] = None) -> dict:
     env = dict(os.environ)
     env["DTF_COORDINATOR"] = coordinator
     env["DTF_PROCESS_ID"] = str(rank)
     env["DTF_PROCESS_COUNT"] = str(world)
+    if heartbeat_dir:
+        # ranks running dtf_tpu mains rewrite
+        # <log_dir>/heartbeat_rank{N}.json at a bounded interval
+        # (obs/watchdog.Heartbeat) — the supervisor's structured
+        # liveness signal, replacing stdout-size scraping
+        env[HEARTBEAT_DIR_ENV] = os.path.abspath(heartbeat_dir)
     if devices_per_process:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count="
@@ -59,8 +89,15 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
     procs = []  # (rank, Popen)
     logs = []
     rc = 0
-    # hang watchdog state: last time each rank's log grew
+    # hang watchdog state: last time each rank showed life — via its
+    # heartbeat file (structured, preferred) or its log growing
+    # (fallback ONLY for ranks that have never emitted a heartbeat: once
+    # a rank has beaten, log growth stops counting, so a rank whose log
+    # grows from a side thread while its training thread is deadlocked
+    # is still caught)
     sizes = [0] * num_processes
+    hb_ts = [None] * num_processes   # last heartbeat payload ts seen
+    hb_mtime = [None] * num_processes  # stat gate: parse only on change
     last_beat = [0.0] * num_processes
     spawned = [0.0] * num_processes
     # restart attempts keep earlier logs (the first failure is usually
@@ -69,11 +106,18 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
     log_path = lambda rank: os.path.join(log_dir, f"log{rank}{suffix}.log")
     try:
         for rank in range(num_processes):
+            # a heartbeat file surviving a previous attempt must not
+            # masquerade as this attempt's first beat
+            try:
+                os.unlink(heartbeat_path(log_dir, rank))
+            except OSError:
+                pass
             f = open(log_path(rank), "wb")
             logs.append(f)
             p = subprocess.Popen(
                 cmd, env=build_env(rank, num_processes, coordinator,
-                                   devices_per_process),
+                                   devices_per_process,
+                                   heartbeat_dir=log_dir),
                 stdout=f, stderr=subprocess.STDOUT)
             procs.append((rank, p))
             last_beat[rank] = spawned[rank] = time.monotonic()
@@ -84,29 +128,54 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                 ret = p.poll()
                 if ret is None:
                     if heartbeat_timeout:
-                        # liveness = the rank's log keeps growing (every
-                        # rank emits BenchmarkMetric lines at
-                        # --log_steps cadence); a stalled log past the
-                        # timeout means a hung collective or deadlock —
-                        # the failure mode the reference could only
-                        # resolve by hand with kill.sh
+                        # liveness: the rank's heartbeat file advanced
+                        # (obs/watchdog beats at a bounded interval even
+                        # when nothing logs — e.g. mid-epoch with a long
+                        # --log_steps); ranks that never beat fall back
+                        # to log growth.  Quiet past the timeout means a
+                        # hung collective or deadlock — the failure mode
+                        # the reference could only resolve by hand with
+                        # kill.sh
+                        now = time.monotonic()
+                        # mtime gate: beats land every heartbeat_secs at
+                        # most, so one stat per poll replaces an
+                        # open+parse per poll
+                        try:
+                            mt = os.stat(
+                                heartbeat_path(log_dir, rank)).st_mtime
+                        except OSError:
+                            mt = hb_mtime[rank]
+                        if mt != hb_mtime[rank]:
+                            hb_mtime[rank] = mt
+                            hb = read_heartbeat(
+                                heartbeat_path(log_dir, rank))
+                            if (hb is not None
+                                    and hb.get("ts") != hb_ts[rank]):
+                                hb_ts[rank] = hb.get("ts")
+                                last_beat[rank] = now
                         try:
                             sz = os.path.getsize(log_path(rank))
                         except OSError:
                             sz = sizes[rank]
-                        now = time.monotonic()
                         if sz != sizes[rank]:
                             sizes[rank] = sz
-                            last_beat[rank] = now
-                        elif (now - last_beat[rank] > heartbeat_timeout
-                              # a rank in first XLA compile / checkpoint
-                              # restore legitimately logs nothing for
-                              # minutes — give every rank a startup
-                              # grace before the heartbeat rule applies
-                              and now - spawned[rank] > startup_grace):
+                            # log growth is liveness only until the
+                            # first heartbeat: after that, a growing log
+                            # with a stale heartbeat is the deadlocked-
+                            # but-chatty signature, not life
+                            if hb_ts[rank] is None:
+                                last_beat[rank] = now
+                        if (now - last_beat[rank] > heartbeat_timeout
+                                # a rank in first XLA compile /
+                                # checkpoint restore legitimately logs
+                                # nothing for minutes — give every rank
+                                # a startup grace before the heartbeat
+                                # rule applies
+                                and now - spawned[rank] > startup_grace):
                             print(f"rank {rank} heartbeat lost "
-                                  f"({heartbeat_timeout:.0f}s without log "
-                                  f"output); killing", file=sys.stderr)
+                                  f"({heartbeat_timeout:.0f}s without "
+                                  f"{'a heartbeat' if hb_ts[rank] is not None else 'log output'}"
+                                  f"); killing", file=sys.stderr)
                             p.kill()
                     continue
                 procs.remove((rank, p))

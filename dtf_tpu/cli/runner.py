@@ -100,6 +100,15 @@ def _channels_first_factory(fn):
 
 
 def run(cfg: Config) -> dict:
+    # structured tracing: --trace_dir, or DTF_TRACE_DIR forwarded by the
+    # launcher to every rank (idempotent when a main already configured)
+    from dtf_tpu.obs import trace
+    from dtf_tpu.obs.registry import default_registry
+    trace.maybe_configure(cfg)
+    # metric.log exports are per-run: a second run() in the same
+    # process (tests, notebooks) must not inherit the previous run's
+    # process-global counters (e.g. PS wire tallies)
+    default_registry().reset()
     export_model = None
     if cfg.export_dir:
         # fail fast: don't discover a missing orbax install only after
@@ -307,8 +316,12 @@ def run(cfg: Config) -> dict:
             eval_iter_fn=None if cfg.skip_eval else eval_fn,
             callbacks=callbacks)
         if bench_log is not None:
-            bench_log.log_stats(stats,
-                                global_step=int(jax.device_get(state.step)))
+            step_now = int(jax.device_get(state.step))
+            bench_log.log_stats(stats, global_step=step_now)
+            # process-global obs registry (PS wire counters etc.) rides
+            # the same metric.log; empty registries write nothing
+            bench_log.log_registry(default_registry(),
+                                   global_step=step_now)
 
     if export_model is not None:
         # --export_dir parity: final inference variables, written once

@@ -94,6 +94,9 @@ def serve(cfg, random_init: bool = False) -> dict:
         blog.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
                           test_id=cfg.benchmark_test_id)
         blog.log_serving_stats(stats)
+        # live engine registry (queue depth, sheds, slot occupancy,
+        # latency histogram) in the same metric.log format
+        blog.log_registry(engine.metrics)
     out = {
         "requests": stats.num_requests,
         "shed": stats.num_shed,
@@ -117,6 +120,9 @@ def main(argv=None) -> dict:
     if random_init:
         argv.remove("--serve_random_init")
     cfg = parse_flags(argv, defaults=SERVE_DEFAULTS)
+    # --trace_dir: serve batch-form/decode spans + shed anomalies
+    from dtf_tpu.obs import trace
+    trace.maybe_configure(cfg)
     return serve(cfg, random_init=random_init)
 
 

@@ -1,0 +1,139 @@
+"""Trace summarizer — turn per-rank JSONL traces into a step report.
+
+Reads the ``trace_rank*.jsonl`` files a traced run wrote (train, PS,
+or serve — any subsystem emitting through dtf_tpu.obs.trace), and
+prints per-span-name timing aggregates (count, total, mean, p50/p99,
+max), event counts, and every anomaly record.
+
+Usage:
+  python -m dtf_tpu.cli.trace_main <trace_dir | trace.jsonl> [...]
+      [--check] [--json]
+
+``--check`` is the CI/bench contract: exit 0 only when the trace
+contains NO anomaly records (nan_loss, step_time_regression, ...), so a
+bench script can assert a run was clean with one command.  ``--json``
+emits the summary as one JSON object instead of the table (machine
+consumers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import Counter as CCounter
+from typing import Dict, List
+
+from dtf_tpu.obs.registry import Histogram
+from dtf_tpu.obs.trace import read_records
+
+
+def discover(paths: List[str]) -> List[str]:
+    """Expand directories to their trace_rank*.jsonl files."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "trace_rank*.jsonl")))
+            if not found:
+                raise FileNotFoundError(
+                    f"no trace_rank*.jsonl files under {p!r}")
+            files.extend(found)
+        else:
+            files.append(p)
+    return files
+
+
+def summarize(files: List[str]) -> dict:
+    spans: Dict[str, Histogram] = {}
+    events: CCounter = CCounter()
+    anomalies: List[dict] = []
+    ranks = set()
+    steps = set()
+    for path in files:
+        for rec in read_records(path):
+            ranks.add(rec.get("rank", 0))
+            kind = rec.get("kind")
+            if kind == "span":
+                name = rec.get("name", "?")
+                h = spans.get(name)
+                if h is None:
+                    h = spans[name] = Histogram(name, unit="s")
+                h.observe(float(rec.get("dur_s", 0.0)))
+                if name == "step" and "step" in rec:
+                    steps.add((rec.get("rank", 0), rec["step"]))
+            elif kind == "event":
+                events[rec.get("name", "?")] += 1
+            elif kind == "anomaly":
+                anomalies.append(rec)
+    span_rows = {}
+    for name, h in sorted(spans.items()):
+        s = h.snapshot()
+        span_rows[name] = {
+            "count": s["count"], "total_s": s["count"] * s["mean"],
+            "mean_s": s["mean"], "p50_s": s["p50"], "p99_s": s["p99"],
+            "max_s": s["max"],
+        }
+    return {
+        "files": files,
+        "ranks": sorted(ranks),
+        "step_spans": len(steps) if steps else (
+            span_rows.get("step", {}).get("count", 0)),
+        "spans": span_rows,
+        "events": dict(sorted(events.items())),
+        "anomalies": anomalies,
+    }
+
+
+def print_summary(summary: dict) -> None:
+    print(f"trace files: {len(summary['files'])}  "
+          f"ranks: {summary['ranks']}  "
+          f"step spans: {summary['step_spans']}")
+    if summary["spans"]:
+        hdr = (f"{'span':<24}{'count':>8}{'total_s':>10}{'mean_s':>10}"
+               f"{'p50_s':>10}{'p99_s':>10}{'max_s':>10}")
+        print(hdr)
+        print("-" * len(hdr))
+        for name, r in summary["spans"].items():
+            print(f"{name:<24}{r['count']:>8}{r['total_s']:>10.3f}"
+                  f"{r['mean_s']:>10.4f}{r['p50_s']:>10.4f}"
+                  f"{r['p99_s']:>10.4f}{r['max_s']:>10.4f}")
+    if summary["events"]:
+        print("events: " + ", ".join(f"{k}×{v}"
+                                     for k, v in summary["events"].items()))
+    for a in summary["anomalies"]:
+        detail = {k: v for k, v in a.items()
+                  if k not in ("kind", "name", "ts")}
+        print(f"ANOMALY: {a.get('name', '?')} {detail}")
+    if not summary["anomalies"]:
+        print("anomalies: none")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dtf_tpu.cli.trace_main",
+        description="Summarize dtf_tpu JSONL traces.")
+    ap.add_argument("paths", nargs="+",
+                    help="trace dir(s) or trace_rank*.jsonl file(s)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero when any anomaly record is present")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    files = discover(args.paths)
+    summary = summarize(files)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print_summary(summary)
+    if args.check and summary["anomalies"]:
+        print(f"--check: {len(summary['anomalies'])} anomaly record(s) — "
+              f"run was NOT clean", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

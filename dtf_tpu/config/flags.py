@@ -216,6 +216,26 @@ class Config:
     serve_requests: int = 16            # synthetic-traffic demo request count
     serve_prompt_len: int = 8           # synthetic prompt length (max; varied)
 
+    # --- observability (dtf_tpu/obs) ---
+    # structured JSONL tracing: each process writes
+    # <trace_dir>/trace_rank{N}.jsonl (step/compile/checkpoint/ps/serve
+    # spans + anomaly events); summarize with
+    # `python -m dtf_tpu.cli.trace_main <trace_dir>`.  "" = off (the
+    # DTF_TRACE_DIR env var — forwarded by the launcher — also enables)
+    trace_dir: str = ""
+    # abort loudly (structured anomaly + TrainingAnomaly) on the first
+    # non-finite loss that reaches the host; checked at --log_steps
+    # cadence on the value the loop already syncs — no extra device
+    # round-trip
+    nan_guard: bool = True
+    # flag a log window taking > factor x the rolling median of recent
+    # windows (input-pipeline stall / straggler signature); reports,
+    # never aborts.  0 disables.
+    step_time_guard_factor: float = 3.0
+    # heartbeat file rewrite interval (launcher supervision); the file
+    # is only written when the launcher exports DTF_HEARTBEAT_DIR
+    heartbeat_secs: float = 5.0
+
     # --- misc ---
     seed: int = 0
     verbose: int = 2                    # keras fit verbose parity (rank-gated)
@@ -284,6 +304,13 @@ class Config:
         if self.serve_max_batch < 1 or self.serve_queue_size < 1:
             raise ValueError(
                 "serve_max_batch and serve_queue_size must be >= 1")
+        if self.step_time_guard_factor and self.step_time_guard_factor <= 1.0:
+            raise ValueError(
+                f"step_time_guard_factor must be > 1.0 (or 0 to disable), "
+                f"got {self.step_time_guard_factor}")
+        if self.heartbeat_secs <= 0:
+            raise ValueError(
+                f"heartbeat_secs must be positive, got {self.heartbeat_secs}")
         if self.eval_only and not self.resume:
             raise ValueError(
                 "--eval_only evaluates a restored checkpoint; pass "

@@ -1,0 +1,268 @@
+"""Structured JSONL tracing.
+
+One record per line, one file per rank (``trace_rank{N}.jsonl``), three
+record kinds:
+
+  span    — a timed region: {"kind":"span","name":...,"ts":<start>,
+            "dur_s":...,"rank":...,"parent":...,  ...attrs}
+            (written when the region EXITS, so a crash mid-span leaves
+            the enclosing spans visible up to the crash point)
+  event   — a point-in-time marker: {"kind":"event","name":...,
+            "ts":..., ...attrs} (e.g. "heartbeat")
+  anomaly — an event that means the run is unhealthy: same shape with
+            kind="anomaly" ("nan_loss", "step_time_regression", ...).
+            `trace_main --check` exits nonzero when any is present.
+
+Design constraints, in order:
+
+  1. disabled == free: every public entry point hits a module-level
+     None check and returns a shared no-op object.  No locks, no
+     allocation, no time syscalls.
+  2. enabled but off the step critical path: records are appended to an
+     in-memory list and flushed to disk every ``flush_every`` records
+     (and at close/atexit), so a per-step span costs two clock reads,
+     one small dict, and an amortized write.
+  3. crash-robust enough to debug the crash: the flush interval bounds
+     the loss window, and abort paths (watchdog) flush explicitly.
+
+The tracer is configured once per process — from ``--trace_dir`` via
+:func:`maybe_configure`, or from the ``DTF_TRACE_DIR`` environment
+variable that the launcher forwards to every rank.  Rank identity comes
+from config/env (``DTF_PROCESS_ID``), NOT from jax — importing this
+module must never initialize a backend.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_tracer: Optional["Tracer"] = None
+_lock = threading.Lock()
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "attrs", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._tracer._stack().append(self.name)
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.time() - self.t0
+        stack = self._tracer._stack()
+        stack.pop()
+        rec = {"kind": "span", "name": self.name, "ts": self.t0,
+               "dur_s": dur}
+        if stack:
+            rec["parent"] = stack[-1]
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec.update(self.attrs)
+        self._tracer.emit(rec)
+        return False
+
+
+class Tracer:
+    """Buffered JSONL writer; thread-safe; one instance per process."""
+
+    def __init__(self, path: str, rank: int = 0, flush_every: int = 256):
+        self.path = os.path.abspath(path)
+        self.rank = int(rank)
+        self.flush_every = max(int(flush_every), 1)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._file = open(self.path, "a", buffering=1024 * 64)
+        self._buf: List[str] = []
+        self._mu = threading.Lock()
+        self._local = threading.local()
+        self.emit({"kind": "event", "name": "trace_start", "ts": time.time(),
+                   "pid": os.getpid()})
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    # -- record emission ----------------------------------------------
+    def emit(self, record: Dict[str, Any]) -> None:
+        record.setdefault("rank", self.rank)
+        line = json.dumps(record, default=str)
+        with self._mu:
+            self._buf.append(line)
+            if len(self._buf) >= self.flush_every:
+                self._flush_locked()
+
+    def span(self, name: str, **attrs) -> _Span:
+        return _Span(self, name, attrs)
+
+    def event(self, name: str, **attrs) -> None:
+        rec = {"kind": "event", "name": name, "ts": time.time()}
+        rec.update(attrs)
+        self.emit(rec)
+
+    def anomaly(self, name: str, **attrs) -> None:
+        rec = {"kind": "anomaly", "name": name, "ts": time.time()}
+        rec.update(attrs)
+        self.emit(rec)
+        self.flush()  # anomalies must survive the crash they predict
+
+    # -- lifecycle -----------------------------------------------------
+    def _flush_locked(self) -> None:
+        if self._buf and not self._file.closed:
+            self._file.write("\n".join(self._buf) + "\n")
+            self._file.flush()
+        self._buf.clear()
+
+    def flush(self) -> None:
+        with self._mu:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._mu:
+            self._flush_locked()
+            if not self._file.closed:
+                self._file.close()
+
+
+# ---------------------------------------------------------------------------
+# Module-level API (what instrumented code calls)
+# ---------------------------------------------------------------------------
+
+def configure(trace_dir: str, rank: Optional[int] = None,
+              flush_every: int = 256) -> Tracer:
+    """Install the process-global tracer writing under ``trace_dir``.
+    Idempotent per (dir, rank): reconfiguring replaces the tracer."""
+    global _tracer
+    if rank is None:
+        rank = int(os.environ.get("DTF_PROCESS_ID", "0"))
+    path = os.path.join(trace_dir, f"trace_rank{rank}.jsonl")
+    with _lock:
+        if _tracer is not None:
+            if _tracer.path == os.path.abspath(path):
+                return _tracer  # same destination — keep the live tracer
+            _tracer.close()
+        _tracer = Tracer(path, rank=rank, flush_every=flush_every)
+    return _tracer
+
+
+def maybe_configure(cfg=None) -> Optional[Tracer]:
+    """Configure from ``cfg.trace_dir`` or the ``DTF_TRACE_DIR`` env var
+    (launcher ranks inherit the env).  Returns the tracer, or None when
+    tracing stays off.  Explicit config wins over env."""
+    trace_dir = (getattr(cfg, "trace_dir", "") or
+                 os.environ.get("DTF_TRACE_DIR", ""))
+    if not trace_dir:
+        return None
+    rank = getattr(cfg, "process_id", None) if cfg is not None else None
+    return configure(trace_dir, rank=rank)
+
+
+def get() -> Optional[Tracer]:
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def disable() -> None:
+    """Close and uninstall the global tracer (tests)."""
+    global _tracer
+    with _lock:
+        if _tracer is not None:
+            _tracer.close()
+        _tracer = None
+
+
+def span(name: str, **attrs):
+    """``with trace.span("step", step=n): ...`` — no-op when disabled."""
+    t = _tracer
+    if t is None:
+        return _NULL_SPAN
+    return t.span(name, **attrs)
+
+
+def event(name: str, **attrs) -> None:
+    t = _tracer
+    if t is not None:
+        t.event(name, **attrs)
+
+
+def span_completed(name: str, dur_s: float, **attrs) -> None:
+    """Emit a span record for a region timed by the caller (used when
+    the duration comes from the caller's own clock — e.g. the train
+    loop's log-window wall time, measured across an explicit device
+    sync — rather than a with-block)."""
+    t = _tracer
+    if t is None:
+        return
+    rec = {"kind": "span", "name": name, "ts": time.time() - dur_s,
+           "dur_s": float(dur_s)}
+    rec.update(attrs)
+    t.emit(rec)
+
+
+def anomaly(name: str, **attrs) -> None:
+    t = _tracer
+    if t is not None:
+        t.anomaly(name, **attrs)
+
+
+def flush() -> None:
+    t = _tracer
+    if t is not None:
+        t.flush()
+
+
+@atexit.register
+def _close_at_exit() -> None:
+    t = _tracer
+    if t is not None:
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# Reading (trace_main + tests)
+# ---------------------------------------------------------------------------
+
+def read_records(path: str) -> List[Dict[str, Any]]:
+    """Parse one JSONL trace file; tolerates a torn final line (the
+    process may have died mid-write)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line from a crash — skip, keep rest
+    return out

@@ -35,6 +35,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from dtf_tpu import native as native_lib
+from dtf_tpu.obs import trace
+from dtf_tpu.obs.registry import default_registry
 
 log = logging.getLogger("dtf_tpu")
 
@@ -540,6 +542,15 @@ class PsClient:
         self.reseed_tolerance = reseed_tolerance
         self._init_msg: Optional[bytes] = None
         self._last_version = 0  # highest store version this client saw
+        # one-off ad-hoc counters absorbed into the obs registry: the
+        # push/pull/reconnect tallies live behind the same API (and
+        # BenchmarkMetric export) as every other subsystem's metrics
+        reg = default_registry()
+        self._m_pulls = reg.counter("ps_client_pulls", unit="ops")
+        self._m_pushes = reg.counter("ps_client_pushes", unit="ops")
+        self._m_reconnects = reg.counter("ps_client_reconnects", unit="ops")
+        self._m_pull_bytes = reg.counter("ps_client_pull_bytes", unit="bytes")
+        self._m_push_bytes = reg.counter("ps_client_push_bytes", unit="bytes")
         self._connect(connect_timeout)
 
     def _connect(self, timeout: float):
@@ -575,6 +586,9 @@ class PsClient:
                 log.warning("ps %s failed; reconnecting to %s "
                             "(%.0fs left)", op_name, self.address,
                             remaining)
+                self._m_reconnects.inc()
+                trace.event("ps_reconnect", op=op_name,
+                            address=f"{self.address[0]}:{self.address[1]}")
                 try:
                     self.sock.close()
                 except OSError:
@@ -689,11 +703,14 @@ class PsClient:
                     flat = np.frombuffer(_recvn(self.sock, 4 * n),
                                          np.float32)
                 self._last_version = max(self._last_version, ver)
+                self._m_pulls.inc()
+                self._m_pull_bytes.inc((2 if bf16 else 4) * int(n))
                 return ver, flat
             return None
 
         while True:
-            got = self._retrying("pull", once)
+            with trace.span("ps_pull", bf16=bf16):
+                got = self._retrying("pull", once)
             if got is not None:
                 return got
             if time.time() > deadline:
@@ -720,9 +737,12 @@ class PsClient:
             if st != 0:
                 raise ValueError(f"ps push rejected: status={st}")
             self._last_version = max(self._last_version, ver)
+            self._m_pushes.inc()
+            self._m_push_bytes.inc(len(msg))
             return ver
 
-        return self._retrying("push", once)
+        with trace.span("ps_push", bf16=bf16):
+            return self._retrying("push", once)
 
     def info(self) -> Tuple[int, int, int]:
         def once():
@@ -1076,63 +1096,99 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
     acc_key = ("categorical_accuracy" if spec.one_hot
                else "sparse_categorical_accuracy")
     history: dict = {"loss": [], acc_key: []}
+    # same watchdog surface as the SPMD loop: NaN guard on the loss
+    # values this loop already syncs, heartbeat when launched under the
+    # supervisor (a PS worker that deadlocks in pull() stops beating)
+    from dtf_tpu.obs.watchdog import Heartbeat, NanLossWatchdog
+    nan_guard = NanLossWatchdog(enabled=getattr(cfg, "nan_guard", True))
+    heartbeat = Heartbeat.from_env(
+        interval_s=getattr(cfg, "heartbeat_secs", 5.0))
     time_cb.on_train_begin()
     local_step = 0
-    for epoch in range(train_epochs):
-        time_cb.on_epoch_begin(epoch)
-        for _ in range(steps_per_epoch):
-            time_cb.on_batch_begin(local_step)
-            version, flat = client.pull(bf16=wire_bf16)
-            images, labels = next(train_iter)
-            gflat, loss, acc, batch_stats = step_fn(
-                jnp.asarray(flat), batch_stats, jnp.asarray(images),
-                jnp.asarray(labels))
-            # ASYNC NETWORK BOUNDARY: push to the store; other workers
-            # may have advanced `version` meanwhile (stale gradients are
-            # inherent to async PS — same as the reference)
-            lr = float(schedule(jnp.asarray(local_step)))
-            client.push(lr, np.asarray(jax.device_get(gflat)),
-                        bf16=wire_bf16)
-            local_step += 1
-            time_cb.on_batch_end(local_step)
-        m_loss, m_acc = float(jax.device_get(loss)), float(jax.device_get(acc))
-        history["loss"].append(m_loss)
-        history[acc_key].append(m_acc)
-        time_cb.on_epoch_end(epoch)
-        log.info("worker %d epoch %d/%d: loss=%.4f top1=%.4f", worker_id,
-                 epoch + 1, train_epochs, m_loss, m_acc)
-    time_cb.on_train_end()
+    # the whole worker body runs under a DONE guarantee: a NaN-guard
+    # abort (or any other worker death past init) must still deliver
+    # this worker's DONE, or the PS rank's wait(num_workers) hangs one
+    # short forever — the exact barrier the done_count persistence
+    # machinery exists to protect
+    try:
+        for epoch in range(train_epochs):
+            time_cb.on_epoch_begin(epoch)
+            for _ in range(steps_per_epoch):
+                time_cb.on_batch_begin(local_step)
+                version, flat = client.pull(bf16=wire_bf16)
+                images, labels = next(train_iter)
+                # the per-step device_get below syncs every step in
+                # this loop anyway, so keeping it INSIDE the span makes
+                # the span a true step time (unlike the SPMD loop's
+                # async-dispatch step spans)
+                with trace.span("step", step=local_step, worker=worker_id):
+                    gflat, loss, acc, batch_stats = step_fn(
+                        jnp.asarray(flat), batch_stats, jnp.asarray(images),
+                        jnp.asarray(labels))
+                    gnp = np.asarray(jax.device_get(gflat))
+                # ASYNC NETWORK BOUNDARY: push to the store; other workers
+                # may have advanced `version` meanwhile (stale gradients are
+                # inherent to async PS — same as the reference)
+                lr = float(schedule(jnp.asarray(local_step)))
+                client.push(lr, gnp, bf16=wire_bf16)
+                local_step += 1
+                time_cb.on_batch_end(local_step)
+                if heartbeat is not None:
+                    heartbeat.beat(step=local_step)
+            m_loss, m_acc = (float(jax.device_get(loss)),
+                             float(jax.device_get(acc)))
+            nan_guard.check(local_step, m_loss)
+            history["loss"].append(m_loss)
+            history[acc_key].append(m_acc)
+            time_cb.on_epoch_end(epoch)
+            log.info("worker %d epoch %d/%d: loss=%.4f top1=%.4f", worker_id,
+                     epoch + 1, train_epochs, m_loss, m_acc)
+        time_cb.on_train_end()
 
-    eval_output = None
-    if not cfg.skip_eval and worker_id == 0:
-        _, flat = client.pull()
-        losses, accs = [], []
-        for images, labels in eval_iter_fn():
-            l, a = eval_fn(jnp.asarray(flat), batch_stats,
-                           jnp.asarray(images), jnp.asarray(labels))
-            losses.append(float(l))
-            accs.append(float(a))
-        if losses:
-            eval_output = (float(np.mean(losses)), float(np.mean(accs)))
-            log.info("worker 0 eval: loss=%.4f top1=%.4f", *eval_output)
-
-    stats = build_stats(history, eval_output, time_cb)
-    if worker_id == 0:
-        if cfg.export_dir:
-            # --export_dir: final store params + this worker's BN stats
-            import types
-            from dtf_tpu.train.checkpoint import export_model
+        eval_output = None
+        if not cfg.skip_eval and worker_id == 0:
             _, flat = client.pull()
-            export_model(cfg.export_dir, types.SimpleNamespace(
-                params=unravel(jnp.asarray(flat)), batch_stats=batch_stats))
-        if cfg.benchmark_log_dir:
-            from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
-            blog = BenchmarkFileLogger(cfg.benchmark_log_dir)
-            blog.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
-                              test_id=cfg.benchmark_test_id)
-            blog.log_stats(stats, global_step=local_step)
-    client.done()
-    client.close()
+            losses, accs = [], []
+            for images, labels in eval_iter_fn():
+                l, a = eval_fn(jnp.asarray(flat), batch_stats,
+                               jnp.asarray(images), jnp.asarray(labels))
+                losses.append(float(l))
+                accs.append(float(a))
+            if losses:
+                eval_output = (float(np.mean(losses)), float(np.mean(accs)))
+                log.info("worker 0 eval: loss=%.4f top1=%.4f", *eval_output)
+
+        stats = build_stats(history, eval_output, time_cb)
+        if worker_id == 0:
+            if cfg.export_dir:
+                # --export_dir: final store params + this worker's BN stats
+                import types
+                from dtf_tpu.train.checkpoint import export_model
+                _, flat = client.pull()
+                export_model(cfg.export_dir, types.SimpleNamespace(
+                    params=unravel(jnp.asarray(flat)),
+                    batch_stats=batch_stats))
+            if cfg.benchmark_log_dir:
+                from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
+                blog = BenchmarkFileLogger(cfg.benchmark_log_dir)
+                blog.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
+                                  test_id=cfg.benchmark_test_id)
+                blog.log_stats(stats, global_step=local_step)
+                # PS wire counters (pulls/pushes/bytes/reconnects) ride
+                # the same metric.log the training stats land in
+                blog.log_registry(default_registry(), global_step=local_step)
+    except BaseException:
+        # dying worker: still deliver DONE (the finally below), but
+        # best-effort FAST — done()'s retried INFO probe must not burn
+        # another full reconnect_timeout against a store that may be
+        # the very thing that just failed
+        client.reconnect_timeout = min(client.reconnect_timeout or 0.0, 5.0)
+        raise
+    finally:
+        try:
+            client.done()  # swallows delivery failures (logs a warning)
+        finally:
+            client.close()
     log.info("Run stats: %s",
              {k: v for k, v in stats.items() if k != "step_timestamp_log"})
     return stats

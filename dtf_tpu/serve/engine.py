@@ -40,6 +40,8 @@ from typing import List, Optional
 import jax
 import numpy as np
 
+from dtf_tpu.obs import trace
+from dtf_tpu.obs.registry import MetricsRegistry
 from dtf_tpu.serve.decode import Decoder
 
 log = logging.getLogger("dtf_tpu")
@@ -141,13 +143,43 @@ class ServeEngine:
         self._slots: List[Optional[_Slot]] = [None] * self.max_batch
         self._stop = threading.Event()
         self._ids = itertools.count()
-        # metrics
+        # metrics: the raw result list stays (collect_stats consumes
+        # it); live operational state goes through the obs registry —
+        # queue depth / slot occupancy gauges, shed/admit/complete
+        # counters, latency histogram — so benches and the benchmark
+        # file logger read one API instead of scraping log lines
         self.completed: List[ServeResult] = []
-        self.shed_count = 0
+        self.metrics = MetricsRegistry()
+        self._m_queue_depth = self.metrics.gauge("serve_queue_depth",
+                                                 unit="requests")
+        self._m_occupancy = self.metrics.gauge("serve_slot_occupancy",
+                                               unit="fraction")
+        self._m_shed = self.metrics.counter("serve_shed_total",
+                                            unit="requests")
+        self._m_admitted = self.metrics.counter("serve_admitted_total",
+                                                unit="requests")
+        self._m_completed = self.metrics.counter("serve_completed_total",
+                                                 unit="requests")
+        self._m_latency = self.metrics.histogram("serve_latency_s", unit="s")
+        self._m_queue_wait = self.metrics.histogram("serve_queue_wait_s",
+                                                    unit="s")
+        # per-engine-iteration samples of the same two signals, so a
+        # finished run still has a distribution (the gauges only hold
+        # the final — drained — values)
+        self._m_queue_sampled = self.metrics.histogram(
+            "serve_queue_depth_sampled", unit="requests")
+        self._m_occ_sampled = self.metrics.histogram(
+            "serve_slot_occupancy_sampled", unit="fraction")
         self._ewma_latency = 0.25       # seed estimate for retry_after
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-engine")
         self._thread.start()
+
+    @property
+    def shed_count(self) -> int:
+        """Total requests shed (single source of truth: the registry
+        counter the benchmark export reads)."""
+        return self._m_shed.value
 
     # -- client side ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -177,7 +209,7 @@ class ServeEngine:
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
             if len(self._pending) >= self.queue_size:
-                self.shed_count += 1
+                self._m_shed.inc()
                 retry = max(0.05, self._ewma_latency
                             * (1 + len(self._pending) / self.max_batch))
                 log.error(
@@ -185,10 +217,14 @@ class ServeEngine:
                     "request (%d total shed); retry_after=%.2fs",
                     len(self._pending), self.max_batch, self.shed_count,
                     retry)
+                trace.anomaly("serve_shed", pending=len(self._pending),
+                              shed_total=self.shed_count,
+                              retry_after=retry)
                 raise Backpressure(retry)
             req.id = next(self._ids)
             req.submit_time = time.time()
             self._pending.append(handle)
+            self._m_queue_depth.set(len(self._pending))
             self._cond.notify_all()
         return handle
 
@@ -242,12 +278,22 @@ class ServeEngine:
                 for i, slot in enumerate(self._slots):
                     if slot is None and self._pending:
                         admitted.append((i, self._pending.pop(0)))
+                self._m_queue_depth.set(len(self._pending))
             if self._stop.is_set() and not any(
                     s is not None for s in self._slots) and not admitted:
                 return
-            for i, handle in admitted:
-                self._admit(i, handle)
-            if any(s is not None for s in self._slots):
+            if admitted:
+                # batch formation: prefill each admitted request into
+                # its slot (the fill-the-batch phase of the recipe)
+                with trace.span("serve_batch_form", admitted=len(admitted)):
+                    for i, handle in admitted:
+                        self._admit(i, handle)
+                self._m_admitted.inc(len(admitted))
+            active = sum(s is not None for s in self._slots)
+            self._m_occupancy.set(active / self.max_batch)
+            if active:
+                self._m_occ_sampled.observe(active / self.max_batch)
+                self._m_queue_sampled.observe(len(self._pending))
                 self._step()
 
     def _admit(self, slot_idx: int, handle: _Handle):
@@ -274,9 +320,10 @@ class ServeEngine:
                 index[i] = s.index
                 temps[i] = s.handle.request.temperature
         self._key, sub = jax.random.split(self._key)
-        out, self._cache, _ = self.decoder.decode_step(
-            self._cache, tokens, index, temps, sub)
-        out = np.asarray(out)
+        with trace.span("serve_decode"):
+            out, self._cache, _ = self.decoder.decode_step(
+                self._cache, tokens, index, temps, sub)
+            out = np.asarray(out)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -309,6 +356,9 @@ class ServeEngine:
             submit_time=req.submit_time, finish_time=req.finish_time)
         self._ewma_latency = (0.8 * self._ewma_latency
                               + 0.2 * result.latency_s)
+        self._m_completed.inc()
+        self._m_latency.observe(result.latency_s)
+        self._m_queue_wait.observe(result.queue_wait_s)
         self.completed.append(result)
         slot.handle._deliver(result)
         with self._cond:

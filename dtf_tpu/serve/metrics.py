@@ -2,6 +2,12 @@
 BenchmarkMetric shape the training side logs (utils/benchmark_logger:
 one ``{"name", "value", "unit", ...}`` record per metric), so the
 benchmark infrastructure consumes training and serving runs uniformly.
+
+The aggregation math lives in the obs metrics registry
+(dtf_tpu/obs/registry.py) — this module's percentiles are registry
+Histogram snapshots, not a second ad-hoc implementation.  The live
+operational counters (queue depth, sheds, slot occupancy) are on
+``ServeEngine.metrics`` directly; this aggregate is the post-run view.
 """
 
 from __future__ import annotations
@@ -9,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional
 
-import numpy as np
+from dtf_tpu.obs.registry import Histogram
 
 
 @dataclasses.dataclass
@@ -63,22 +69,25 @@ def collect_stats(results, shed_count: int = 0,
     if not results:
         return ServingStats(0, shed_count, 0, 0.0, 0.0,
                             0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
-    lat = np.array([r.latency_s for r in results])
-    ttft = np.array([r.time_to_first_token_s for r in results])
-    qw = np.array([r.queue_wait_s for r in results])
+    lat = Histogram("latency", unit="s")
+    ttft = Histogram("ttft", unit="s")
+    qw = Histogram("queue_wait", unit="s")
+    for r in results:
+        lat.observe(r.latency_s)
+        ttft.observe(r.time_to_first_token_s)
+        qw.observe(r.queue_wait_s)
     total_tokens = int(sum(len(r.tokens) for r in results))
     if wall_time_s is None:
         wall_time_s = (max(r.finish_time for r in results)
                        - min(r.submit_time for r in results))
     tps = total_tokens / wall_time_s if wall_time_s > 0 else 0.0
-    pct = lambda a, q: float(np.percentile(a, q))
     return ServingStats(
         num_requests=len(results),
         num_shed=int(shed_count),
         total_new_tokens=total_tokens,
         wall_time_s=float(wall_time_s),
         tokens_per_s=float(tps),
-        latency_p50_s=pct(lat, 50), latency_p90_s=pct(lat, 90),
-        latency_p99_s=pct(lat, 99),
-        ttft_p50_s=pct(ttft, 50), ttft_p99_s=pct(ttft, 99),
-        queue_wait_p50_s=pct(qw, 50))
+        latency_p50_s=lat.percentile(50), latency_p90_s=lat.percentile(90),
+        latency_p99_s=lat.percentile(99),
+        ttft_p50_s=ttft.percentile(50), ttft_p99_s=ttft.percentile(99),
+        queue_wait_p50_s=qw.percentile(50))

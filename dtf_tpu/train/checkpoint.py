@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import orbax.checkpoint as ocp
 
+from dtf_tpu.obs import trace
+
 log = logging.getLogger("dtf_tpu")
 
 
@@ -39,7 +41,8 @@ class Checkpointer:
 
     def save(self, state, step: Optional[int] = None) -> None:
         step = int(state.step) if step is None else int(step)
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        with trace.span("checkpoint_save", step=step):
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
         log.info("checkpoint saved: step %d -> %s", step, self.directory)
 
     def latest_step(self) -> Optional[int]:
@@ -53,11 +56,13 @@ class Checkpointer:
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             return None
-        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
-                                          abstract_state)
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-        if sharding is not None:
-            restored = jax.device_put(restored, sharding)
+        with trace.span("checkpoint_restore", step=int(step)):
+            abstract = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, abstract_state)
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+            if sharding is not None:
+                restored = jax.device_put(restored, sharding)
         log.info("checkpoint restored: step %d from %s", step, self.directory)
         return restored
 
@@ -76,8 +81,9 @@ def export_model(export_dir: str, state) -> str:
     path = os.path.abspath(os.path.join(export_dir, "model"))
     ckptr = ocp.StandardCheckpointer()
     payload = {"params": state.params, "batch_stats": state.batch_stats}
-    ckptr.save(path, payload, force=True)
-    ckptr.wait_until_finished()
+    with trace.span("checkpoint_export"):
+        ckptr.save(path, payload, force=True)
+        ckptr.wait_until_finished()
     ckptr.close()
     log.info("model exported to %s", path)
     return path
@@ -101,7 +107,8 @@ def load_train_checkpoint(model_dir: str, step: Optional[int] = None):
         step = mgr.latest_step() if step is None else step
         if step is None:
             return None
-        restored = mgr.restore(step, args=ocp.args.StandardRestore())
+        with trace.span("checkpoint_restore", step=int(step)):
+            restored = mgr.restore(step, args=ocp.args.StandardRestore())
     finally:
         mgr.close()
     if not isinstance(restored, dict) or "params" not in restored:

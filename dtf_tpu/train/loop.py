@@ -40,6 +40,9 @@ from dtf_tpu.config import Config
 from dtf_tpu.data.base import DatasetSpec
 from dtf_tpu.models.partition import spec_axes as _spec_axes
 from dtf_tpu.models.registry import l2_weight_penalty
+from dtf_tpu.obs import trace
+from dtf_tpu.obs.watchdog import (Heartbeat, NanLossWatchdog,
+                                  StepTimeWatchdog)
 from dtf_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
                                   MeshRuntime)
 from dtf_tpu.train import schedules as sched_lib
@@ -749,14 +752,20 @@ class Trainer:
         self.eval_step = jax.jit(eval_sharded)
 
     # ------------------------------------------------------------------
-    def evaluate(self, state: TrainState, eval_iter: Iterator):
+    def evaluate(self, state: TrainState, eval_iter: Iterator,
+                 heartbeat=None):
         """Weighted-exact eval: batches are (images, labels[, mask]);
         a missing mask means every example is real.  Returns
         (mean loss, top-1) over exactly the unmasked examples, or None
         when the iterator is empty.  top-1 is None under
-        --report_accuracy_metrics false."""
+        --report_accuracy_metrics false.  ``heartbeat``: beaten per
+        batch so a long eval under the launcher supervisor stays
+        visibly alive (the step loop — the usual beat site — is idle
+        here)."""
         loss_sums, correct_sums, counts = [], [], []
         for batch in eval_iter:
+            if heartbeat is not None:
+                heartbeat.beat()
             if len(batch) == 2:
                 images, labels = batch
                 mask = np.ones((np.asarray(labels).shape[0],), np.float32)
@@ -788,6 +797,24 @@ class Trainer:
         resumed_step = int(jax.device_get(state.step))
         time_cb = TimeHistory(self.global_batch, cfg.log_steps,
                               initial_global_step=resumed_step)
+        # watchdogs (obs/watchdog): the NaN check reads the loss value
+        # this loop already syncs at log cadence; the step-time guard
+        # watches the same per-window wall time TimeHistory reports; the
+        # heartbeat only exists when the launcher exported
+        # DTF_HEARTBEAT_DIR.  All host-side, all off unless configured.
+        nan_guard = NanLossWatchdog(enabled=getattr(cfg, "nan_guard", True))
+        guard_factor = getattr(cfg, "step_time_guard_factor", 0.0) or 0.0
+        step_guard = (StepTimeWatchdog(factor=guard_factor)
+                      if guard_factor else None)
+        heartbeat = Heartbeat.from_env(
+            interval_s=getattr(cfg, "heartbeat_secs", 5.0))
+        compile_pending = True
+        window_t0 = time.monotonic()
+        # a skewed window covers non-step time (first-compile, or an
+        # epoch boundary's eval/checkpoint) or fewer than log_steps
+        # steps (post-boundary partial): emitting it would misreport
+        # step_s and pollute the watchdog's rolling median — skip it
+        window_skewed = True
         callbacks = [time_cb] + list(callbacks or [])
         acc_key = ("categorical_accuracy" if self.spec.one_hot
                    else "sparse_categorical_accuracy")
@@ -809,74 +836,131 @@ class Trainer:
         if start_epoch:
             log.info("resuming at step %d (epoch %d)", global_step, start_epoch)
         t0 = time.time()
-        for epoch in range(start_epoch, self.train_epochs):
-            for cb in callbacks:
-                _call(cb, "on_epoch_begin", epoch, None)
-            for batch_idx in range(self.steps_per_epoch):
+        try:
+            for epoch in range(start_epoch, self.train_epochs):
                 for cb in callbacks:
-                    _call(cb, "on_batch_begin", batch_idx, None)
-                if (profile_range and not profile_started
-                        and global_step >= profile_range[0]
-                        and global_step <= profile_range[1]):
-                    jax.profiler.start_trace(cfg.model_dir)
-                    profiling = True
-                    profile_started = True
-                images, labels = next(train_iter)
-                if hasattr(images, "device"):  # already sharded by prefetcher
-                    sharded = (images, labels)
-                else:
-                    sharded = self.rt.shard_batch((images, labels))
-                state, metrics = self.train_step(state, *sharded)
-                global_step += 1
-                if global_step % cfg.log_steps == 0:
-                    # device_get (host copy): block_until_ready can
-                    # return early on some remote platforms
-                    jax.device_get(metrics["loss"])
-                if profiling and global_step > profile_range[1]:
-                    jax.profiler.stop_trace()
-                    profiling = False
+                    _call(cb, "on_epoch_begin", epoch, None)
+                for batch_idx in range(self.steps_per_epoch):
+                    for cb in callbacks:
+                        _call(cb, "on_batch_begin", batch_idx, None)
+                    if (profile_range and not profile_started
+                            and global_step >= profile_range[0]
+                            and global_step <= profile_range[1]):
+                        jax.profiler.start_trace(cfg.model_dir)
+                        profiling = True
+                        profile_started = True
+                    images, labels = next(train_iter)
+                    if hasattr(images, "device"):  # already sharded by prefetcher
+                        sharded = (images, labels)
+                    else:
+                        sharded = self.rt.shard_batch((images, labels))
+                    # NOTE: jit dispatch is async — a "step" span measures
+                    # host-side dispatch (sub-ms once compiled), which is
+                    # what makes it cheap enough to emit every step.  It
+                    # exists for counting/attribution and host-stall
+                    # detection; SYNCED wall-clock timing comes from the
+                    # "log_window" spans below (and the "compile" span,
+                    # whose first call blocks on trace+compile).
+                    if compile_pending:
+                        compile_pending = False
+                        with trace.span("compile", step=global_step):
+                            with trace.span("step", step=global_step):
+                                state, metrics = self.train_step(state, *sharded)
+                    else:
+                        with trace.span("step", step=global_step):
+                            state, metrics = self.train_step(state, *sharded)
+                    global_step += 1
+                    if global_step % cfg.log_steps == 0:
+                        # device_get (host copy): block_until_ready can
+                        # return early on some remote platforms
+                        loss_val = jax.device_get(metrics["loss"])
+                        nan_guard.check(global_step, float(loss_val))
+                        now = time.monotonic()
+                        if not window_skewed:
+                            # the one host-measured duration that spans a
+                            # real device sync: log_steps steps of true
+                            # wall time — the per-step timing signal
+                            window_s = now - window_t0
+                            trace.span_completed(
+                                "log_window", window_s, step=global_step,
+                                steps=cfg.log_steps,
+                                step_s=window_s / cfg.log_steps)
+                            if step_guard is not None:
+                                step_guard.observe(global_step, window_s)
+                        window_t0 = now
+                        window_skewed = False
+                    if heartbeat is not None:
+                        heartbeat.beat(step=global_step)
+                    if profiling and global_step > profile_range[1]:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    for cb in callbacks:
+                        _call(cb, "on_batch_end", batch_idx, None)
+                # epoch end: materialize the last step's metrics (keras history
+                # records per-epoch training metrics)
+                m = jax.device_get(metrics)
+                nan_guard.check(global_step, float(m["loss"]))
+                trace.event("epoch_end", epoch=epoch, step=global_step,
+                            loss=float(m["loss"]))
+                history["loss"].append(float(m["loss"]))
+                if "accuracy" in m:
+                    history[acc_key].append(float(m["accuracy"]))
                 for cb in callbacks:
-                    _call(cb, "on_batch_end", batch_idx, None)
-            # epoch end: materialize the last step's metrics (keras history
-            # records per-epoch training metrics)
-            m = jax.device_get(metrics)
-            history["loss"].append(float(m["loss"]))
-            if "accuracy" in m:
-                history[acc_key].append(float(m["accuracy"]))
-            for cb in callbacks:
-                _call(cb, "on_epoch_end", epoch,
-                      {"state": state, "history": history})
-            if cfg.verbose and (jax.process_index() == 0):
-                log.info("epoch %d/%d: loss=%.4f top1=%s lr=%.5f",
-                         epoch + 1, self.train_epochs, history["loss"][-1],
-                         ("%.4f" % m["accuracy"]) if "accuracy" in m
-                         else "n/a", float(m["learning_rate"]))
-            run_eval = (not cfg.skip_eval and eval_iter_fn is not None and
-                        ((epoch + 1) % cfg.epochs_between_evals == 0 or
-                         epoch + 1 == self.train_epochs))
-            if run_eval:
-                eval_output = self.evaluate(state, eval_iter_fn())
-                if eval_output and jax.process_index() == 0:
-                    log.info("eval: loss=%.4f top1=%s", eval_output[0],
-                             ("%.4f" % eval_output[1])
-                             if eval_output[1] is not None else "n/a")
-                # --stop_threshold parity (model_helpers.past_stop_threshold
-                # via flags_core.define_base): end training once eval top-1
-                # reaches the threshold
-                if (eval_output and cfg.stop_threshold is not None
-                        and eval_output[1] is not None
-                        and eval_output[1] >= cfg.stop_threshold):
-                    if jax.process_index() == 0:
-                        log.info("stop_threshold %.4f reached (top1=%.4f) — "
-                                 "stopping early at epoch %d",
-                                 cfg.stop_threshold, eval_output[1], epoch + 1)
-                    break
-        if profiling:
-            jax.profiler.stop_trace()
+                    _call(cb, "on_epoch_end", epoch,
+                          {"state": state, "history": history})
+                if heartbeat is not None:
+                    # epoch-boundary work (checkpoint save above, eval
+                    # below) runs outside the step loop's beat site — beat
+                    # here so a slow save doesn't read as a dead rank
+                    heartbeat.beat(step=global_step)
+                if cfg.verbose and (jax.process_index() == 0):
+                    log.info("epoch %d/%d: loss=%.4f top1=%s lr=%.5f",
+                             epoch + 1, self.train_epochs, history["loss"][-1],
+                             ("%.4f" % m["accuracy"]) if "accuracy" in m
+                             else "n/a", float(m["learning_rate"]))
+                run_eval = (not cfg.skip_eval and eval_iter_fn is not None and
+                            ((epoch + 1) % cfg.epochs_between_evals == 0 or
+                             epoch + 1 == self.train_epochs))
+                if run_eval:
+                    with trace.span("eval", epoch=epoch, step=global_step):
+                        eval_output = self.evaluate(state, eval_iter_fn(),
+                                                    heartbeat=heartbeat)
+                    if eval_output and jax.process_index() == 0:
+                        log.info("eval: loss=%.4f top1=%s", eval_output[0],
+                                 ("%.4f" % eval_output[1])
+                                 if eval_output[1] is not None else "n/a")
+                    # --stop_threshold parity (model_helpers.past_stop_threshold
+                    # via flags_core.define_base): end training once eval top-1
+                    # reaches the threshold
+                    if (eval_output and cfg.stop_threshold is not None
+                            and eval_output[1] is not None
+                            and eval_output[1] >= cfg.stop_threshold):
+                        if jax.process_index() == 0:
+                            log.info("stop_threshold %.4f reached (top1=%.4f) — "
+                                     "stopping early at epoch %d",
+                                     cfg.stop_threshold, eval_output[1], epoch + 1)
+                        break
+                # the epoch boundary just spent wall time on non-step work
+                # (metrics sync, eval — incl. its one-time compile —
+                # checkpoint-save callbacks): restart the step-time guard's
+                # window here, or the next log window would measure that
+                # work as a step-time regression on a healthy run
+                window_t0 = time.monotonic()
+                window_skewed = True  # next boundary closes a partial window
+                if heartbeat is not None:
+                    heartbeat.beat(step=global_step)
+        finally:
+            # one teardown for every exit — normal completion, the
+            # stop_threshold break, and watchdog aborts
+            # (TrainingAnomaly) alike: an in-flight profiler trace is
+            # stopped and flushed, not orphaned mid-dump
+            if profiling:
+                jax.profiler.stop_trace()
         if (start_epoch >= self.train_epochs and not cfg.skip_eval
                 and eval_iter_fn is not None):
             # resumed a fully-trained checkpoint: still honor the eval ask
-            eval_output = self.evaluate(state, eval_iter_fn())
+            eval_output = self.evaluate(state, eval_iter_fn(),
+                                        heartbeat=heartbeat)
             if eval_output and jax.process_index() == 0:
                 log.info("eval (resumed, no further training): loss=%.4f "
                          "top1=%s", eval_output[0],
@@ -890,6 +974,9 @@ class Trainer:
             jax.device_get(metrics["loss"])
         log.info("train wall time: %.1fs (%d steps)",
                  time.time() - t0, global_step)
+        trace.event("train_end", step=global_step,
+                    wall_s=time.time() - t0)
+        trace.flush()
         stats = build_stats(history, eval_output, time_cb)
         return state, stats
 

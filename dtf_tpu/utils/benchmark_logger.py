@@ -100,6 +100,15 @@ class BenchmarkFileLogger:
         for rec in serving_stats.to_metrics():
             self.log_metric(rec["name"], rec["value"], unit=rec["unit"])
 
+    def log_registry(self, registry,
+                     global_step: Optional[int] = None) -> None:
+        """Record an obs.MetricsRegistry's contents: counters/gauges as
+        themselves, histograms expanded to percentile scalars — every
+        line still the one BenchmarkMetric record shape."""
+        for rec in registry.to_benchmark_metrics():
+            self.log_metric(rec["name"], rec["value"], unit=rec["unit"],
+                            global_step=global_step)
+
 
 def _jsonable(obj):
     try:

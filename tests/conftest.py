@@ -54,3 +54,33 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+# --- test-budget bookkeeping (tools/marker_audit.py) ----------------------
+# Every run dumps {nodeid: {duration, slow}} so the marker audit can
+# fail CI when an unmarked test exceeds the per-test time ceiling —
+# the guard that keeps tier-1 under its wall-clock budget as the
+# multi-device compile tests grow.
+
+_durations: dict = {}
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call":
+        _durations[report.nodeid] = {
+            "duration": round(report.duration, 3),
+            "slow": "slow" in getattr(report, "keywords", {}),
+        }
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _durations:
+        return
+    import json
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".last_durations.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(_durations, f, indent=1, sort_keys=True)
+    except OSError:
+        pass  # a read-only checkout must not fail the suite

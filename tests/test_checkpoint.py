@@ -40,6 +40,7 @@ def _make(tmp_path, **kw):
     return cfg, rt, trainer
 
 
+@pytest.mark.slow
 def test_save_restore_roundtrip(tmp_path):
     cfg, rt, trainer = _make(tmp_path)
     images = np.zeros((8, 8, 8, 3), np.float32)
@@ -61,6 +62,7 @@ def test_save_restore_roundtrip(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_resume_preserves_tensor_parallel_sharding(tmp_path, eight_devices):
     """Resume of a TP run must restore the model-axis shardings, not
     flatten them to replicated (the CLI passes the live state's own
@@ -86,6 +88,7 @@ def test_resume_preserves_tensor_parallel_sharding(tmp_path, eight_devices):
         run(Config(**base, resume=True))  # restores sharded; must not crash
 
 
+@pytest.mark.slow
 def test_resume_zero_tp_composed(tmp_path, eight_devices):
     """ZeRO×TP: flat ('data','model')-sliced optimizer state and
     TP-sharded params round-trip through save+resume with their
@@ -124,6 +127,7 @@ def test_restore_none_when_empty(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_run_with_checkpoint_and_resume(tmp_path):
     """e2e: run saves per-epoch; second run with --resume continues from
     the saved step (and trains zero additional steps here)."""
@@ -138,6 +142,7 @@ def test_run_with_checkpoint_and_resume(tmp_path):
     assert "loss" not in stats2 or stats2.get("train_finish_time")
 
 
+@pytest.mark.slow
 def test_profile_steps_honored_under_resume(tmp_path, monkeypatch):
     """--profile_steps "0,10" on a resumed run whose start step (2) already
     passed the range start must still trace the remaining in-range steps
@@ -159,6 +164,7 @@ def test_profile_steps_honored_under_resume(tmp_path, monkeypatch):
     assert calls["start"] == 1 and calls["stop"] == 1
 
 
+@pytest.mark.slow
 def test_eval_only_from_checkpoint(tmp_path):
     """Train + save, then --eval_only --resume evaluates the restored
     state without training."""

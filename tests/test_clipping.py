@@ -65,6 +65,7 @@ def test_clip_is_exact_under_tensor_parallelism(eight_devices):
                                    err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 def test_clip_actually_clips(eight_devices):
     """A tiny threshold must change the update; a huge one must not."""
     base = _flat(_one_step(1, clip=None))

@@ -56,6 +56,7 @@ def real_cardinalities(monkeypatch):
     monkeypatch.setitem(data_base._SPECS, "cifar10", spec)
 
 
+@pytest.mark.slow
 def test_cifar_binary_convergence_and_resume(cifar_real_dir, tmp_path):
     model_dir = str(tmp_path / "run")
     common = dict(model="resnet20", dataset="cifar10",
@@ -84,6 +85,7 @@ def test_cifar_binary_convergence_and_resume(cifar_real_dir, tmp_path):
     assert 0.0 <= stats2["accuracy_top_1"] <= 1.0
 
 
+@pytest.mark.slow
 def test_resume_continues_not_restarts(cifar_real_dir, tmp_path):
     """The resumed run starts at the checkpointed step, so the second
     call trains 1 additional epoch, not 2 from scratch."""

@@ -230,6 +230,7 @@ def test_get_next_as_optional_forces_partial_batch_eval():
     assert cfg.drop_remainder is False
 
 
+@pytest.mark.slow
 def test_run_channels_first_end_to_end(monkeypatch):
     """run() with channels_first: pipelines feed NCHW, same final loss."""
     from dtf_tpu.cli import run

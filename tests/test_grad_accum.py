@@ -51,6 +51,7 @@ def lm_cfg(**kw):
     return Config(**kw)
 
 
+@pytest.mark.slow
 def test_accum_matches_single_pass(tiny_transformer_registry):
     """BN-free model: accumulated microbatch grads are exactly the
     full-batch grads, so the loss trajectories coincide."""
@@ -65,6 +66,7 @@ def test_accum_with_data_parallel(tiny_transformer_registry):
     assert np.isfinite(s["loss"])
 
 
+@pytest.mark.slow
 def test_accum_with_bn_model():
     s = run(Config(model="resnet20", dataset="cifar10", batch_size=8,
                    train_steps=2, use_synthetic_data=True, skip_eval=True,

@@ -86,6 +86,7 @@ def test_dynamic_scale_doubles_after_growth_interval():
     assert float(metrics["loss_scale"]) == DYNAMIC_SCALE_INIT * 2
 
 
+@pytest.mark.slow
 def test_dynamic_scale_e2e_cli():
     stats = run(Config(model="resnet20", dataset="cifar10", batch_size=8,
                        train_steps=2, use_synthetic_data=True,

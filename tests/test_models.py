@@ -31,6 +31,7 @@ def test_resnet50_param_count():
     assert n_params(v["params"]) == 25_559_081
 
 
+@pytest.mark.slow
 def test_resnet50_space_to_depth_stem_exact():
     """The s2d stem (Conv1SpaceToDepth) is a pure reformulation of the
     reference 7×7/2 conv: same param tree, same logits."""
@@ -46,6 +47,7 @@ def test_resnet50_space_to_depth_stem_exact():
         np.asarray(m_ref.apply(v, x, train=False)), atol=5e-4)
 
 
+@pytest.mark.slow
 def test_resnet50_odd_input_falls_back_to_plain_conv():
     """Non-even spatial dims can't space-to-depth; the plain conv path
     keeps the model usable on any input size."""
@@ -86,6 +88,7 @@ def test_tagged_batchnorm_bit_exact_vs_flax():
         np.asarray(mine_e.apply(vm, x), np.float32))
 
 
+@pytest.mark.slow
 def test_resnet50_remat_grad_exact():
     """--remat (selective conv_out/bn_stats policy) is bit-identical in
     outputs, gradients, and batch-stats updates — it only re-schedules
@@ -113,6 +116,7 @@ def test_resnet50_remat_grad_exact():
     assert m1.apply(v, xi, train=False).shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet50_fp8_residuals_probe():
     """fp8_residuals: forward and eval are exact; only dW sees the
     quantized activations (bounded relative error).  A byte-lever probe

@@ -112,6 +112,7 @@ def test_ep_logits_match_unsharded(eight_devices):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_ep_grads_match_unsharded(eight_devices):
     """Gradient exactness under EP with the per-leaf reduction the
     Trainer applies: replicated leaves pmean over 'data'; expert leaves
@@ -236,6 +237,7 @@ def tiny_moe_registry(monkeypatch):
          64, 0.0))
 
 
+@pytest.mark.slow
 def test_ep_training_matches_single_device(tiny_moe_registry):
     """The EP invariant end-to-end: identical loss trajectory whether
     the 4 experts are sharded across 4 data shards or colocated."""
@@ -244,6 +246,7 @@ def test_ep_training_matches_single_device(tiny_moe_registry):
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_moe_remat_policy_matches_no_remat(tiny_moe_registry):
     """--remat_policy dots on the MoE family: same trajectory as the
     no-remat model (the expert all_to_all re-runs in the backward
@@ -295,6 +298,7 @@ def test_scatter_dispatch_matches_dense_oracle():
                                    err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 def test_ep_over_model_axis_matches_single_device(tiny_moe_registry):
     """Experts on the 'model' axis (r1 hard-errored here): group size
     decoupled from dp — dp=2 × ep=4 — same trajectory as one device."""

@@ -525,6 +525,7 @@ def test_worker_survives_ps_crash_and_restore(tmp_path):
         server2.stop()
 
 
+@pytest.mark.slow
 def test_run_async_snapshot_dir_e2e(tmp_path):
     """--ps_snapshot_dir through the CLI path, BOTH branches of the
     production code: run 1 writes a restorable snapshot (version 2);

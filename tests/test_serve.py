@@ -286,6 +286,7 @@ def test_bridge_missing_checkpoint_fails_loudly(tmp_path):
         load_inference_variables(model_dir=str(tmp_path / "nope"))
 
 
+@pytest.mark.slow
 def test_serve_main_random_init_demo(tmp_path, monkeypatch):
     """The CLI entry end-to-end on a tiny config: synthetic traffic
     through the engine, BenchmarkMetric-format metric.log written."""

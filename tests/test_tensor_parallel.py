@@ -221,6 +221,7 @@ def tiny_transformer_registry(monkeypatch):
          64, 0.0))
 
 
+@pytest.mark.slow
 def test_tp_training_matches_single_device(tiny_transformer_registry):
     """The TP invariant: identical loss trajectory whether heads/ff are
     sharded or not (same global batch, replicated data across mp)."""
@@ -254,6 +255,7 @@ def test_remat_policy_composes_with_tp_and_sp(tiny_transformer_registry):
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_vocab_sharded_training_matches_single_device(
         tiny_transformer_registry):
     """--shard_lm_head end-to-end: same loss trajectory as the dense

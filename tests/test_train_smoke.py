@@ -57,6 +57,7 @@ def check_stats(stats, eval_ran=False):
 
 # --- strategy × device-count matrix (reference resnet_cifar_test.py) ---
 
+@pytest.mark.slow
 def test_no_dist_strat():
     check_stats(run(base_cfg(distribution_strategy="off")))
 
@@ -69,6 +70,7 @@ def test_mirrored_2_devices():
     check_stats(run(base_cfg(distribution_strategy="mirrored", num_devices=2)))
 
 
+@pytest.mark.slow
 def test_mirrored_8_devices():
     check_stats(run(base_cfg(distribution_strategy="mirrored")))
 
@@ -77,6 +79,7 @@ def test_tpu_strategy_alias():
     check_stats(run(base_cfg(distribution_strategy="tpu")))
 
 
+@pytest.mark.slow
 def test_horovod_parity_mode():
     check_stats(run(base_cfg(distribution_strategy="horovod")))
 
@@ -98,6 +101,7 @@ def test_fp16_with_loss_scale():
 
 # --- workload cells ---
 
+@pytest.mark.slow
 def test_imagenet_resnet50_tiny():
     check_stats(run(base_cfg(model="resnet50", dataset="imagenet",
                              batch_size=8, num_devices=2)))
@@ -108,27 +112,32 @@ def test_trivial_model_switch():
     check_stats(run(base_cfg(use_trivial_model=True, dataset="imagenet")))
 
 
+@pytest.mark.slow
 def test_eval_path():
     stats = run(base_cfg(skip_eval=False, train_steps=2))
     check_stats(stats, eval_ran=True)
 
 
+@pytest.mark.slow
 def test_sync_bn():
     check_stats(run(base_cfg(sync_bn=True)))
 
 
+@pytest.mark.slow
 def test_tensor_lr():
     check_stats(run(base_cfg(dataset="imagenet", use_tensor_lr=True)))
 
 
 # --- determinism / correctness ---
 
+@pytest.mark.slow
 def test_same_seed_same_loss():
     s1 = run(base_cfg(seed=3))
     s2 = run(base_cfg(seed=3))
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_data_parallel_matches_single_device():
     """The SPMD invariant: global batch B on 1 device ≡ B split over 4
     replicas (per-replica BN differs only if batch statistics differ —
@@ -140,6 +149,7 @@ def test_data_parallel_matches_single_device():
     np.testing.assert_allclose(s1["loss"], s4["loss"], rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_cli_main_smoke():
     """The reference's own smoke invocation (resnet_cifar_test.py:36-40)."""
     stats = cifar_main(["--use_synthetic_data", "--train_steps", "1",
@@ -161,6 +171,7 @@ def test_train_steps_cap():
     assert tr.train_epochs == 1
 
 
+@pytest.mark.slow
 def test_stop_threshold_early_stop(caplog):
     """--stop_threshold parity: training halts once eval top-1 passes
     the threshold (threshold 0.0 ⇒ stop after the first eval epoch)."""
@@ -173,6 +184,7 @@ def test_stop_threshold_early_stop(caplog):
     assert any("stop_threshold" in r.message for r in caplog.records)
 
 
+@pytest.mark.slow
 def test_export_dir_roundtrip(tmp_path):
     """--export_dir parity: final inference variables written and
     restorable."""
@@ -184,6 +196,7 @@ def test_export_dir_roundtrip(tmp_path):
     assert "batch_stats" in restored
 
 
+@pytest.mark.slow
 def test_benchmark_log_dir(tmp_path):
     """logger.benchmark_context parity: benchmark_run.log metadata +
     metric.log JSON lines."""

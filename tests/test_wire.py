@@ -251,6 +251,7 @@ def test_imagenet_eval_wire_parity(imagenet_dir):
 # end-to-end: training over the u8 wire reproduces the f32 wire
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_trainer_u8_wire_matches_f32(cifar_dir, monkeypatch):
     import dataclasses
     import dtf_tpu.data.base as data_base

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Marker audit — fail when an unmarked test exceeds the time ceiling.
+
+Tier-1 runs `-m 'not slow'` under a hard wall-clock budget (ROADMAP:
+870 s on a 1-core box).  That budget only holds if every genuinely
+heavy test (multi-device compiles, e2e PS runs) carries the `slow`
+marker — and nothing enforces that by itself: a new test that compiles
+an 8-way mesh quietly adds a minute to every CI run until someone
+notices the suite timing out.
+
+This audit closes the loop.  The test session dumps per-test call
+durations to ``tests/.last_durations.json`` (conftest hook); run the
+suite, then:
+
+    python tools/marker_audit.py [--ceiling 20] [--path tests/.last_durations.json]
+
+Exit 1 (listing offenders) when any test WITHOUT the `slow` marker took
+longer than the ceiling.  Marked-slow tests may take as long as they
+like — they are excluded from tier-1 by construction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_CEILING_S = 20.0
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", ".last_durations.json")
+
+
+def audit(durations: dict, ceiling_s: float) -> list:
+    """Returns [(nodeid, duration), ...] of unmarked tests over the
+    ceiling, slowest first."""
+    offenders = [(nodeid, rec["duration"])
+                 for nodeid, rec in durations.items()
+                 if not rec.get("slow") and rec["duration"] > ceiling_s]
+    return sorted(offenders, key=lambda kv: -kv[1])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ceiling", type=float, default=DEFAULT_CEILING_S,
+                    help="per-test call-time ceiling in seconds for "
+                         "tests not marked slow (default %(default)s)")
+    ap.add_argument("--path", default=DEFAULT_PATH,
+                    help="durations dump written by the conftest hook")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.path) as f:
+            durations = json.load(f)
+    except OSError as e:
+        print(f"marker_audit: cannot read {args.path} ({e}) — run the "
+              f"test suite first (the conftest hook writes it)",
+              file=sys.stderr)
+        return 2
+
+    offenders = audit(durations, args.ceiling)
+    if offenders:
+        print(f"marker_audit: {len(offenders)} unmarked test(s) over the "
+              f"{args.ceiling:g}s ceiling — mark them "
+              f"@pytest.mark.slow or make them faster:")
+        for nodeid, dur in offenders:
+            print(f"  {dur:8.1f}s  {nodeid}")
+        return 1
+    n = len(durations)
+    print(f"marker_audit: OK — {n} tests, none unmarked over "
+          f"{args.ceiling:g}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
