@@ -1,7 +1,7 @@
 """Serving benchmark: KV-cache decode throughput + end-to-end latency.
 
 Prints ONE JSON line per metric, bench.py contract ({"metric", "value",
-"unit", "vs_baseline", ...}).  Two layers are measured:
+"unit", "vs_baseline", ...}).  Three layers are measured:
 
   1. raw decode-step throughput at batch 1 vs batch N (same model
      config, same cache capacity) — the number that justifies the
@@ -12,6 +12,15 @@ Prints ONE JSON line per metric, bench.py contract ({"metric", "value",
   2. engine-level synthetic traffic (burst of varied-length prompts
      through submit/batch/decode/retire) — latency percentiles +
      delivered tokens/s, the serving-SLA view.
+  3. MIXED-LENGTH scenario (short decodes + one max-length prompt
+     admitted mid-flight) in three configurations: paged+chunked
+     prefill with the pool at 50% of the contiguous reservation,
+     paged+un-chunked (same pool), and the contiguous cache.  Records
+     delivered tokens/s, the p99 decode-step GAP of running slots (the
+     head-of-line-blocking number chunked prefill bounds), peak
+     concurrent slots, and the page-pool high-water mark.  Bars:
+     paged@50% ≥ 1.2× contiguous tokens/s at ≥ the same concurrency;
+     chunked p99 gap < un-chunked p99 gap.
 
 Run: python bench_serve.py [--model transformer_small] [--batch 8]
      [--steps 64] [--seq 256]
@@ -73,6 +82,71 @@ def decode_tokens_per_s(model, params, batch: int, seq: int,
     return batch * steps / dt
 
 
+def mixed_scenario(model, params, *, batch: int, seq: int, requests: int,
+                   kv_page_size, kv_pool_pages, prefill_chunk,
+                   label: str, n_long: int = 3):
+    """Short decodes + ``n_long`` max-length prompts admitted
+    mid-flight (staggered).  Several longs, not one: a single
+    whole-prompt prefill is one outlier among ~100 gap samples and
+    hides BELOW p99 by arithmetic — recurring long prompts are both
+    the realistic long-context traffic and the shape where p99
+    actually reflects the blocking.
+
+    Returns (stats, decode-gap snapshot, max_concurrent, high_water)."""
+    from dtf_tpu.serve import ServeEngine, collect_stats
+    eng = ServeEngine(model, params, max_batch=batch, max_seq_len=seq,
+                      max_delay_s=0.0, queue_size=max(64, 2 * requests),
+                      kv_page_size=kv_page_size,
+                      kv_pool_pages=kv_pool_pages,
+                      prefill_chunk=prefill_chunk)
+    rng = np.random.default_rng(2)
+    long_len = seq - 8
+    # warmup: compile every shape the measured traffic will hit (short
+    # first-chunk, long first/continuation chunks, decode step) — a
+    # production engine warms at startup, so compile must not masquerade
+    # as head-of-line blocking in the measured gap distribution
+    warm = [eng.submit(rng.integers(0, model.vocab_size, (n,)).astype(
+        np.int32), max_new_tokens=2) for n in (8, long_len)]
+    for h in warm:
+        h.result(timeout=600)
+    n_warm = eng.reset_measurement()
+    t0 = time.time()
+    handles = []
+    for _ in range(requests):
+        plen = int(rng.integers(4, 17))
+        handles.append(eng.submit(
+            rng.integers(0, model.vocab_size, (plen,)).astype(np.int32),
+            max_new_tokens=48))
+    # let the short requests admit and reach steady-state decode, THEN
+    # drop the max-length prompts on them — the head-of-line case
+    time.sleep(0.3)
+    for _ in range(n_long):
+        handles.append(eng.submit(
+            rng.integers(0, model.vocab_size,
+                         (long_len,)).astype(np.int32),
+            max_new_tokens=8))
+        time.sleep(0.2)
+    for h in handles:
+        h.result(timeout=600)
+    wall = time.time() - t0
+    stats = collect_stats(eng.completed[n_warm:], eng.shed_count,
+                          wall_time_s=wall)
+    gap = eng.metrics.get("serve_decode_gap_s").snapshot()
+    maxc = eng.max_concurrent
+    high = eng.pool.high_water if eng.pool is not None else 0
+    eng.stop()
+    _jline(f"serve_mixed_tokens_per_s_{label}", stats.tokens_per_s,
+           "tokens/s", requests=stats.num_requests, long_prompt=long_len)
+    _jline(f"serve_mixed_decode_gap_p99_{label}", gap["p99"], "s",
+           mean=round(gap["mean"], 5), samples=gap["count"])
+    _jline(f"serve_mixed_max_concurrent_{label}", maxc, "slots")
+    if eng.pool is not None:
+        _jline(f"serve_kv_pages_high_water_{label}", high, "pages",
+               pool_usable=eng.pool.usable_pages,
+               page_size=eng.page_size)
+    return stats, gap, maxc, high
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="transformer_small")
@@ -80,6 +154,20 @@ def main():
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--kv_page_size", type=int, default=16)
+    # chunk for the mixed scenario's chunked arm.  Measured frontier
+    # (CPU, transformer_small, seq 1024): whole-prompt flash prefill
+    # 0.56 s vs 0.21 s max per 128-token chunk — the gap bound the
+    # chunked arm must demonstrate; 64-token chunks bound tighter
+    # (0.17 s) but pay 1.6x the total prefill work
+    ap.add_argument("--prefill_chunk", type=int, default=128)
+    # the mixed-length scenario runs at a LONGER context than the
+    # decode-throughput sections: chunked prefill exists for prompts
+    # whose single-shot prefill visibly blocks running decodes, which
+    # starts around 4x the step-shape sequence on this hardware
+    # (at 512 the whole-prompt flash pass is already cheaper than one
+    # chunk's gather-attend, and chunking can only add overhead)
+    ap.add_argument("--mixed_seq", type=int, default=1024)
     args = ap.parse_args()
 
     from dtf_tpu.models import build_model
@@ -134,9 +222,56 @@ def main():
            p90=round(occ["p90"], 4), samples=occ["count"])
     _jline("serve_queue_depth_p90", qd["p90"], "requests",
            max=qd["max"], mean=round(qd["mean"], 4))
+
+    # mixed-length scenario: paged (50% pool, chunked / un-chunked)
+    # vs contiguous — the long-context serving acceptance numbers
+    ps = args.kv_page_size
+    pages_full = args.batch * (-(-args.mixed_seq // ps))
+    pool_half = 1 + pages_full // 2
+    mixed_requests = min(args.requests, 12)
+    if mixed_requests != args.requests:
+        # no silent caps: the scenario bounds runtime at 12 requests —
+        # say so, or the serve_mixed_* numbers read as --requests load
+        print(f"# mixed-length scenario capped at {mixed_requests} "
+              f"requests (--requests {args.requests}); sections 1-2 "
+              f"honored the flag")
+    mixed = dict(batch=args.batch, seq=args.mixed_seq,
+                 requests=mixed_requests)
+    s_chunk, g_chunk, c_chunk, _ = mixed_scenario(
+        model, params, kv_page_size=ps, kv_pool_pages=pool_half,
+        prefill_chunk=args.prefill_chunk, label="paged_chunked", **mixed)
+    _, g_plain, _, _ = mixed_scenario(
+        model, params, kv_page_size=ps, kv_pool_pages=pool_half,
+        prefill_chunk=0, label="paged_unchunked", **mixed)
+    s_contig, _, c_contig, _ = mixed_scenario(
+        model, params, kv_page_size=None, kv_pool_pages=None,
+        prefill_chunk=None, label="contiguous", **mixed)
+    paged_speedup = (s_chunk.tokens_per_s / s_contig.tokens_per_s
+                     if s_contig.tokens_per_s > 0 else 0.0)
+    _jline("serve_mixed_paged_vs_contig_speedup", paged_speedup, "x",
+           pool_fraction=0.5,
+           meets_1_2x_bar=bool(paged_speedup >= 1.2),
+           concurrency_sustained=bool(c_chunk >= c_contig))
+    _jline("serve_mixed_chunked_gap_improvement",
+           (g_plain["p99"] / g_chunk["p99"]) if g_chunk["p99"] > 0
+           else 0.0, "x",
+           chunked_below_unchunked=bool(g_chunk["p99"] < g_plain["p99"]))
+
+    # acceptance bars, enforced the same way as the 2x decode bar — a
+    # printed false boolean that exits 0 is not a contract
     if ratio < 2.0:
         raise SystemExit(
             f"batched decode speedup {ratio:.2f}x is below the 2x bar")
+    if paged_speedup < 1.2 or c_chunk < c_contig:
+        raise SystemExit(
+            f"paged@50% mixed-length bar failed: {paged_speedup:.2f}x "
+            f"tokens/s (bar 1.2x), concurrency {c_chunk} vs contiguous "
+            f"{c_contig}")
+    if g_chunk["p99"] >= g_plain["p99"]:
+        raise SystemExit(
+            f"chunked prefill did not bound the decode gap: p99 "
+            f"{g_chunk['p99']:.3f}s chunked vs {g_plain['p99']:.3f}s "
+            f"un-chunked")
 
 
 if __name__ == "__main__":

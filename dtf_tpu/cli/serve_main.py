@@ -42,7 +42,8 @@ def serve(cfg, random_init: bool = False) -> dict:
     """Build model + params + engine from a Config; run the synthetic
     traffic demo; return the stats dict.  Library entry for tests."""
     from dtf_tpu.models import build_model
-    from dtf_tpu.serve import ServeEngine, collect_stats, load_for_serving
+    from dtf_tpu.serve import (ServeEngine, collect_stats, load_for_serving,
+                               serving_memory_plan)
     from dtf_tpu.serve.bridge import place_for_serving
 
     if not cfg.model.startswith("transformer"):
@@ -63,11 +64,24 @@ def serve(cfg, random_init: bool = False) -> dict:
         variables = load_for_serving(model_dir=cfg.model_dir,
                                      export_dir=cfg.export_dir)
 
+    # paged KV cache by default (--kv_page_size 0 restores the
+    # contiguous per-slot layout); the memory plan makes pool sizing a
+    # logged decision
+    serving_memory_plan(model, num_slots=cfg.serve_max_batch,
+                        max_seq_len=max_seq,
+                        kv_page_size=cfg.kv_page_size,
+                        kv_pool_pages=cfg.kv_pool_pages)
     engine = ServeEngine(
         model, variables["params"],
         max_batch=cfg.serve_max_batch, max_seq_len=max_seq,
         max_delay_s=cfg.serve_max_delay_ms / 1000.0,
-        queue_size=cfg.serve_queue_size, seed=cfg.seed)
+        queue_size=cfg.serve_queue_size, seed=cfg.seed,
+        kv_page_size=cfg.kv_page_size or None,
+        kv_pool_pages=cfg.kv_pool_pages or None,
+        # Config.validate guarantees serve_prefill_chunk is None when
+        # the paged cache is off, so this never trips the engine's
+        # contradiction check
+        prefill_chunk=cfg.serve_prefill_chunk)
 
     # synthetic traffic: varied-length prompts, all submitted up front
     # (a burst — the shape that exercises batching + the queue)

@@ -43,6 +43,7 @@ class DevicePrefetcher:
         self._rt = runtime
         self._q: queue.Queue = queue.Queue(maxsize=buffer_size)
         self._err = None
+        self._done = False
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -59,9 +60,15 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
-        item = self._q.get()
-        if item is None:
-            if self._err is not None:
-                raise self._err
-            raise StopIteration
-        return item
+        # the worker's terminal None is put exactly once — latch it, so
+        # a SECOND __next__ after the error (a retry loop, a tqdm
+        # wrapper, a confused caller) re-raises instead of blocking
+        # forever on the now-empty queue
+        if not self._done:
+            item = self._q.get()
+            if item is not None:
+                return item
+            self._done = True
+        if self._err is not None:
+            raise self._err
+        raise StopIteration

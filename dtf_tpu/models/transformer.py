@@ -52,6 +52,8 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from dtf_tpu.ops.flash_attention import flash_attention
+from dtf_tpu.ops.paged_attention import (cached_attention, paged_attention,
+                                         write_pages)
 from dtf_tpu.parallel.collectives import tp_psum, tp_region
 from dtf_tpu.parallel.ring_attention import ring_attention
 
@@ -75,22 +77,9 @@ def remat_policy(name: str):
     raise ValueError(f"unknown remat_policy {name!r}; choose 'dots'")
 
 
-def _cached_attention(q, k, v, mask):
-    """Dense attention against a fixed-size KV cache.
-
-    q [B, S, H, Dh] (S = the chunk being decoded), k/v [B, L, H, Dh]
-    (L = the cache capacity), mask [B, S, L] True where the query may
-    attend.  Scores/softmax run in f32 (the flash kernels' accumulator
-    precision); masked positions get a large negative score, and the
-    output is cast back to q's dtype.  At decode shapes (S ∈ {1, P},
-    L fixed) the [S, L] score tile is small — no flash kernel needed."""
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
-    return o.astype(q.dtype)
+# dense fixed-window cache attention — shared with the paged gather
+# path, single-sourced in ops.paged_attention
+_cached_attention = cached_attention
 
 
 class CausalSelfAttention(nn.Module):
@@ -102,9 +91,17 @@ class CausalSelfAttention(nn.Module):
     # serving: maintain a KV cache ('cache' collection) and attend
     # incrementally — see TransformerLM.decode
     decode: bool = False
+    # paged KV cache (decode only): the cache is a SHARED page pool
+    # [kv_pool_pages, kv_page_size, H, Dh] per K/V plus a caller-owned
+    # block table — see TransformerLM.kv_page_size and
+    # ops.paged_attention for the layout/invariants
+    kv_page_size: Optional[int] = None
+    kv_pool_pages: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x, cache_index=None):
+    def __call__(self, x, cache_index=None, block_table=None,
+                 flash_prefill: bool = False,
+                 window_pages: Optional[int] = None):
         b, s, d = x.shape
         head_dim = d // self.num_heads
         heads = self.num_heads
@@ -127,7 +124,59 @@ class CausalSelfAttention(nn.Module):
         qkv = nn.DenseGeneral((3, heads, head_dim), dtype=self.dtype,
                               name="qkv")(x)
         q, k, v = (qkv[..., i, :, :] for i in range(3))  # [B, S, Hloc, Dh]
-        if self.decode:
+        if self.decode and self.kv_page_size is not None:
+            if cache_index is None or block_table is None:
+                raise ValueError("paged decode mode needs cache_index [B] "
+                                 "and block_table [B, M], both int32")
+            # paged cache: one shared pool per K/V, sized by the module
+            # attrs (NOT by the init call's shapes — admission capacity
+            # is a pool property, not a per-slot reservation)
+            pool_shape = (self.kv_pool_pages, self.kv_page_size,
+                          heads, head_dim)
+            paged_key = self.variable(
+                "cache", "paged_key", jnp.zeros, pool_shape, k.dtype)
+            paged_value = self.variable(
+                "cache", "paged_value", jnp.zeros, pool_shape, v.dtype)
+            if not self.is_initializing():
+                # write-then-attend, same ordering contract as the
+                # contiguous path below.  Prefill chunks (S a page
+                # multiple; page-aligned starts by engine construction)
+                # scatter whole pages; decode steps (S = 1) scatter
+                # single token rows
+                aligned = s > 1 and s % self.kv_page_size == 0
+                paged_key.value = write_pages(
+                    paged_key.value, k, block_table, cache_index,
+                    page_aligned=aligned)
+                paged_value.value = write_pages(
+                    paged_value.value, v, block_table, cache_index,
+                    page_aligned=aligned)
+                if flash_prefill:
+                    # first prefill chunk (cache_index == 0, engine
+                    # invariant): there is no prefix to gather — the
+                    # chunk IS the whole attended history, plain causal
+                    # self-attention through the flash kernel at
+                    # O(S·D) HBM traffic instead of an [S, L] gather
+                    o = flash_attention(q, k, v, causal=True,
+                                        use_pallas=self.use_pallas)
+                else:
+                    # window_pages (STATIC, decode.py computes it from
+                    # the chunk's start) trims the gather to the pages
+                    # the chunk can actually see: continuation-chunk
+                    # attention costs O(S · progress), so total prefill
+                    # work is O(prompt²/2) regardless of the pool's
+                    # logical capacity.  None (the decode step) attends
+                    # the full per-slot window — lengths vary per row
+                    table = (block_table if window_pages is None
+                             else block_table[:, :window_pages])
+                    o = paged_attention(q, paged_key.value,
+                                        paged_value.value, table,
+                                        cache_index)
+            else:
+                # init trace: only the pool variables' shapes matter,
+                # but keep the math valid (plain causal attention)
+                o = flash_attention(q, k, v, causal=True,
+                                    use_pallas=self.use_pallas)
+        elif self.decode:
             if cache_index is None:
                 raise ValueError("decode mode needs cache_index [B] int32")
             # cache capacity is fixed by the INIT call's sequence length
@@ -197,15 +246,22 @@ class Block(nn.Module):
     model_axis: Optional[str] = None
     use_pallas: Any = None
     decode: bool = False
+    kv_page_size: Optional[int] = None
+    kv_pool_pages: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x, cache_index=None):
+    def __call__(self, x, cache_index=None, block_table=None,
+                 flash_prefill: bool = False,
+                 window_pages: Optional[int] = None):
         d = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + CausalSelfAttention(
             self.num_heads, dtype=self.dtype, seq_axis=self.seq_axis,
             model_axis=self.model_axis, use_pallas=self.use_pallas,
-            decode=self.decode, name="attn")(h, cache_index)
+            decode=self.decode, kv_page_size=self.kv_page_size,
+            kv_pool_pages=self.kv_pool_pages,
+            name="attn")(h, cache_index, block_table, flash_prefill,
+                         window_pages)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         d_ff = self.d_ff
         if self.model_axis is not None:
@@ -255,9 +311,23 @@ class TransformerLM(nn.Module):
     # makes slot-based continuous batching possible).  Incompatible with
     # seq/model sharding and shard_vocab (decode is single-device).
     decode: bool = False
+    # Paged KV cache (decode only; serve/decode.py Decoder drives it):
+    # instead of a per-slot [B, max_seq_len] slab, every attention keeps
+    # a SHARED [kv_pool_pages, kv_page_size, H, Dh] page pool per K/V,
+    # and __call__ additionally takes `block_table` [B, M] int32 (the
+    # engine-allocated page ids mapping each row's logical positions
+    # into the pool — ops.paged_attention has the layout and the
+    # scratch-page invariant) plus `flash_prefill` (static bool: the
+    # chunk starts at position 0, so attention runs causal-only through
+    # the flash kernel with no gather).  HBM then scales with tokens in
+    # flight, not num_slots × max_seq_len.
+    kv_page_size: Optional[int] = None
+    kv_pool_pages: Optional[int] = None
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, cache_index=None):
+    def __call__(self, tokens, train: bool = False, cache_index=None,
+                 block_table=None, flash_prefill: bool = False,
+                 window_pages: Optional[int] = None):
         del train  # no dropout/BN: LN only, same train/eval behavior
         b, s_local = tokens.shape
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
@@ -273,6 +343,10 @@ class TransformerLM(nn.Module):
                                  "shard_vocab (single-device serving)")
             if cache_index is None:
                 raise ValueError("decode mode needs cache_index [B] int32")
+            if (self.kv_page_size is None) != (self.kv_pool_pages is None):
+                raise ValueError(
+                    "kv_page_size and kv_pool_pages must be set together "
+                    "(both for the paged cache, neither for contiguous)")
             # per-row global positions; clamp so a padded prefill chunk
             # can't index past the table (those rows' logits are unused)
             pos_idx = jnp.minimum(
@@ -295,7 +369,10 @@ class TransformerLM(nn.Module):
             x = block(self.num_heads, self.d_ff, dtype=self.dtype,
                       seq_axis=self.seq_axis, model_axis=self.model_axis,
                       use_pallas=self.use_pallas, decode=self.decode,
-                      name=f"block{i}")(x, cache_index)
+                      kv_page_size=self.kv_page_size,
+                      kv_pool_pages=self.kv_pool_pages,
+                      name=f"block{i}")(x, cache_index, block_table,
+                                        flash_prefill, window_pages)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         vocab = self.vocab_size
         if self.shard_vocab and self.model_axis is not None:

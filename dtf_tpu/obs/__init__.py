@@ -8,8 +8,9 @@ in their own ad-hoc format.  This package gives every subsystem one
 structured, near-zero-overhead vocabulary:
 
   trace     — JSONL span/event emitter (step, compile, checkpoint
-              save/restore, PS push/pull, serve batch-form/decode) with
-              wall time, rank, and step attributes.  Summarize with
+              save/restore, PS push/pull, serve batch-form/
+              prefill-chunk/decode) with wall time, rank, and step
+              attributes.  Summarize with
               `python -m dtf_tpu.cli.trace_main <trace_dir>`.
   registry  — counters / gauges / histograms with percentile
               snapshots, exported in the existing BenchmarkMetric

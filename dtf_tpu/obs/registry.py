@@ -119,6 +119,18 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
+    def reset(self) -> None:
+        """Drop all observations (the instrument stays registered).
+        Benches use this to exclude warmup/compile traffic from the
+        measured distribution — the engine's references stay live,
+        unlike MetricsRegistry.reset() which drops the instruments."""
+        with self._mu:
+            self._samples.clear()
+            self._count = 0
+            self._sum = 0.0
+            self._min = math.inf
+            self._max = -math.inf
+
     def percentile(self, q: float) -> float:
         """Linear-interpolated percentile over the reservoir — the same
         definition as numpy.percentile's default method."""

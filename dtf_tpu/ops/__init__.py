@@ -10,6 +10,8 @@ attention avoids.
 from dtf_tpu.ops.blockwise import (NEG_INF, block_accumulate,
                                    blockwise_attention, mha_reference)
 from dtf_tpu.ops.flash_attention import flash_attention
+from dtf_tpu.ops.paged_attention import (cached_attention, gather_pages,
+                                         paged_attention, write_pages)
 
 __all__ = [
     "NEG_INF",
@@ -17,4 +19,8 @@ __all__ = [
     "blockwise_attention",
     "mha_reference",
     "flash_attention",
+    "cached_attention",
+    "gather_pages",
+    "paged_attention",
+    "write_pages",
 ]

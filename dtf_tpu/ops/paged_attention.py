@@ -1,0 +1,136 @@
+"""Paged KV-cache primitives: page-pool writes, block-table gathers,
+and gather-attention for serving decode.
+
+The contiguous serving cache (one [num_slots, max_seq_len, H, Dh] slab
+per layer) reserves worst-case HBM for every slot: a 4-token request
+holds the same memory as a max-length one.  The paged layout is the
+vLLM/PagedAttention discipline adapted to fixed-shape XLA:
+
+  page pool    — one [num_pages, page_size, H, Dh] array per layer per
+                 K/V, shared by every slot.  Token at logical position
+                 ``p`` of a slot lives at pool row
+                 ``block_table[slot, p // page_size]``, offset
+                 ``p % page_size``.
+  block table  — [B, max_pages_per_slot] int32 page ids, maintained
+                 host-side by the serving engine's allocator.  Entries
+                 for unallocated tail pages are 0 — see the scratch-page
+                 invariant below.
+  scratch page — pool page 0 is never handed to a request.  Inactive
+                 rows of a fixed-shape decode batch still execute the
+                 write (XLA has no dynamic batch), and their garbage
+                 must land somewhere that no live sequence reads:
+                 the engine passes an all-zeros block-table row for
+                 such rows, steering both the write and the (ignored)
+                 gather at page 0.
+
+Everything here is shape-static: the gather always materializes the
+full ``max_pages_per_slot * page_size`` logical window and masks, so
+the decode step compiles exactly once regardless of pool occupancy.
+
+``cached_attention`` (dense attention against a fixed-capacity KV
+window, f32 softmax) also lives here — it is the shared score/softmax
+math for both the contiguous cache path (models/transformer.py) and
+the paged gather path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cached_attention(q, k, v, mask):
+    """Dense attention against a fixed-size KV window.
+
+    q [B, S, H, Dh] (S = the chunk being decoded), k/v [B, L, H, Dh]
+    (L = the window capacity), mask [B, S, L] True where the query may
+    attend.  Scores/softmax run in f32 (the flash kernels' accumulator
+    precision); masked positions get a large negative score, and the
+    output is cast back to q's dtype.  At decode shapes (S small, L
+    fixed) the [S, L] score tile is small — no flash kernel needed."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return o.astype(q.dtype)
+
+
+def write_pages(pool, new, block_table, index, page_aligned: bool = False):
+    """Scatter a [B, S, H, Dh] chunk of K or V into the page pool.
+
+    ``pool`` [P, page_size, H, Dh]; ``block_table`` [B, M] int32 page
+    ids; ``index`` [B] int32 — the chunk's starting logical position
+    per row (token i of row b lands at logical position index[b] + i).
+
+    ``page_aligned`` (static) promises index % page_size == 0 and
+    S % page_size == 0 for every row — the prefill-chunk case by
+    engine construction.  The write then scatters WHOLE pages
+    (S/page_size contiguous [page_size, H, Dh] blocks per row) instead
+    of S individual token rows: XLA lowers the page-granular scatter to
+    block memcpys where the token-granular form degenerates to
+    row-at-a-time copies.  Decode steps (S = 1, arbitrary offset) take
+    the token path.
+
+    Positions past the block table's logical capacity (M * page_size)
+    are clamped to the last logical slot; the engine's invariants make
+    such writes garbage-onto-garbage (a padded prefill tail), never a
+    live-token overwrite that the mask could later admit unwritten.
+    Rows whose block-table entries are all 0 write into the scratch
+    page (see module docstring)."""
+    num_pages, page_size, h, dh = pool.shape
+    b, s = new.shape[:2]
+    capacity = block_table.shape[1] * page_size
+    if page_aligned:
+        n_pages = s // page_size
+        pstart = index // page_size                          # [B]
+        pidx = jnp.minimum(
+            pstart[:, None] + jnp.arange(n_pages, dtype=jnp.int32)[None, :],
+            block_table.shape[1] - 1)
+        page = jnp.take_along_axis(block_table, pidx, axis=1)  # [B, n]
+        return pool.at[page.reshape(-1)].set(
+            new.reshape(b * n_pages, page_size, h, dh))
+    pos = index[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    pos = jnp.minimum(pos, capacity - 1)                     # [B, S]
+    page = jnp.take_along_axis(block_table, pos // page_size, axis=1)
+    flat = page * page_size + pos % page_size                # [B, S]
+    pool_flat = pool.reshape(num_pages * page_size, h, dh)
+    pool_flat = pool_flat.at[flat.reshape(-1)].set(
+        new.reshape(b * s, h, dh))
+    return pool_flat.reshape(pool.shape)
+
+
+def gather_pages(pool, block_table):
+    """Gather each row's full logical KV window from the pool.
+
+    ``pool`` [P, page_size, H, Dh], ``block_table`` [B, M] →
+    [B, M * page_size, H, Dh], ordered by logical position (page 0 of
+    the row first).  PAGE-granular: the gather moves M whole
+    [page_size, H, Dh] blocks per row (contiguous memcpys under XLA),
+    never individual tokens.  Unallocated entries gather the scratch
+    page — callers mask those positions out (they are always ≥ the
+    row's current length)."""
+    num_pages, page_size, h, dh = pool.shape
+    b, m = block_table.shape
+    return pool[block_table].reshape(b, m * page_size, h, dh)
+
+
+def paged_attention(q, pool_k, pool_v, block_table, index):
+    """Attention of a chunk of queries over a slot's paged KV history.
+
+    q [B, S, H, Dh] — S new queries per row, the row's global positions
+    being ``index[b] + i``; pool_k/pool_v [P, page_size, H, Dh];
+    block_table [B, M]; index [B] int32.  The chunk's own K/V must
+    already be written into the pool (write-then-attend, exactly the
+    contiguous cache path's ordering), so query i sees logical
+    positions j <= index + i: the just-written chunk causally, the
+    prefix fully, and never the unwritten tail (masked)."""
+    k = gather_pages(pool_k, block_table)   # [B, L, H, Dh]
+    v = gather_pages(pool_v, block_table)
+    s = q.shape[1]
+    capacity = k.shape[1]
+    jpos = jnp.arange(capacity, dtype=jnp.int32)[None, None, :]
+    qpos = (index[:, None, None]
+            + jnp.arange(s, dtype=jnp.int32)[None, :, None])
+    return cached_attention(q, k, v, jpos <= qpos)

@@ -3,10 +3,12 @@ dynamic batching engine (see docs in each module)."""
 
 from dtf_tpu.serve.bridge import (load_for_serving,       # noqa: F401
                                   load_inference_variables,
-                                  place_for_serving)
+                                  place_for_serving,
+                                  serving_memory_plan)
 from dtf_tpu.serve.decode import (Decoder, init_cache,    # noqa: F401
+                                  init_paged_cache,
                                   make_decode_model,
                                   teacher_forced_logits)
-from dtf_tpu.serve.engine import (Backpressure,           # noqa: F401
+from dtf_tpu.serve.engine import (Backpressure, PagePool,  # noqa: F401
                                   ServeEngine, ServeRequest, ServeResult)
 from dtf_tpu.serve.metrics import ServingStats, collect_stats  # noqa: F401

@@ -76,3 +76,48 @@ def load_for_serving(model_dir: str = "", export_dir: str = "",
     return place_for_serving(
         load_inference_variables(model_dir, export_dir, step=step),
         devices=devices)
+
+
+def serving_memory_plan(model, *, num_slots: int, max_seq_len: int,
+                        kv_page_size: int = 0,
+                        kv_pool_pages: int = 0) -> dict:
+    """Byte accounting for a serving deployment: params + KV cache.
+
+    The KV side is where the paged cache earns its keep: the contiguous
+    layout reserves ``num_slots × max_seq_len`` token slots per layer
+    regardless of traffic, while the paged pool holds
+    ``(kv_pool_pages − 1) × kv_page_size`` tokens TOTAL — sized to the
+    expected tokens in flight, not the worst case.  ``kv_pool_pages``
+    of 0 = the full contiguous-equivalent reservation (plus the scratch
+    page).  Returns dict with ``kv_bytes_contiguous``,
+    ``kv_bytes_paged``, ``kv_tokens_capacity`` and the layer geometry —
+    serve_main logs it so pool sizing is a visible decision, not a
+    guess."""
+    import numpy as np
+
+    head_dim = model.d_model // model.num_heads
+    # 2 arrays (K and V) per layer; cache dtype follows compute dtype
+    # (np.dtype resolves jnp scalar types incl. bfloat16 via ml_dtypes)
+    elem = np.dtype(model.dtype).itemsize
+    per_token = 2 * model.num_layers * model.num_heads * head_dim * elem
+    pages_per_slot = -(-max_seq_len // max(kv_page_size, 1))
+    full_pages = 1 + num_slots * pages_per_slot
+    pool_pages = int(kv_pool_pages) or full_pages
+    contiguous_tokens = num_slots * max_seq_len
+    paged_tokens = (pool_pages - 1) * kv_page_size if kv_page_size else 0
+    plan = {
+        "per_token_kv_bytes": per_token,
+        "kv_bytes_contiguous": contiguous_tokens * per_token,
+        "kv_bytes_paged": paged_tokens * per_token,
+        "kv_tokens_capacity": paged_tokens or contiguous_tokens,
+        "pages_per_slot": pages_per_slot if kv_page_size else 0,
+        "pool_pages": pool_pages if kv_page_size else 0,
+    }
+    log.info(
+        "serving memory plan: %d slots x %d tokens; KV contiguous %.1f "
+        "MB%s", num_slots, max_seq_len,
+        plan["kv_bytes_contiguous"] / 2**20,
+        (f", paged pool {plan['kv_bytes_paged'] / 2**20:.1f} MB "
+         f"({pool_pages} pages x {kv_page_size} tokens)"
+         if kv_page_size else " (paged cache off)"))
+    return plan

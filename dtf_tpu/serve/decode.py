@@ -3,22 +3,38 @@
 The training forward is teacher-forced: logits for every position in
 one pass.  Serving needs the autoregressive form — one new token per
 step — without recomputing the whole prefix.  The model side lives in
-``models/transformer.py`` (``decode=True``: every attention keeps
-``cached_key``/``cached_value`` in the 'cache' collection and takes a
-per-row ``cache_index``); this module owns the jit-compiled step
-functions around it:
+``models/transformer.py`` (``decode=True``: every attention keeps a KV
+cache in the 'cache' collection and takes a per-row ``cache_index``);
+this module owns the jit-compiled step functions around it:
 
   - ``init_cache``      — zeros cache pytree with fixed [B, L] shapes
   - ``prefill``         — write one padded prompt into one cache slot and
                           sample the first generated token
+  - ``prefill_chunk``   — (paged mode) write one chunk of a prompt into
+                          the slot's pages; the final chunk also samples
   - ``decode_step``     — one token for every slot in the batch
   - ``teacher_forced_logits`` — the training-style forward, the oracle
                           the decode path is verified token-exact against
 
-Everything is shaped for slot-based continuous batching: the cache is
-[num_slots, max_seq_len, H, Dh] per layer, ``cache_index`` is [B], and
-both step functions compile ONCE (fixed shapes; scalars like the slot id
-and prompt length are traced arrays, never Python ints).
+Two cache layouts, selected by ``Decoder(kv_page_size=...)``:
+
+  contiguous (legacy) — [num_slots, max_seq_len, H, Dh] per layer;
+      prefill pads the prompt to max_seq_len and runs ONE dense
+      [L, L]-masked pass.  Simple, but every admit pays O(L²) attention
+      and every slot reserves worst-case HBM.
+  paged — a shared [pool_pages, page_size, H, Dh] pool per layer plus
+      per-slot block tables (ops.paged_attention).  Prefill runs in
+      page-aligned chunks: the FIRST chunk goes through the flash
+      kernel (pure causal self-attention, no gather), later chunks
+      gather the paged prefix.  Work scales with the PROMPT length, not
+      the cache capacity, and the engine can interleave decode steps
+      between chunks.  Compiles once per chunk length (the engine uses
+      one fixed chunk size, so in practice: first-chunk body, continue
+      body, and the short-prompt whole-pad shapes).
+
+Everything is shaped for slot-based continuous batching: ``cache_index``
+is [B], and the decode step compiles ONCE (fixed shapes; scalars like
+the slot id and prompt length are traced arrays, never Python ints).
 
 Sampling: greedy when temperature == 0, else softmax sampling at
 ``logits / temperature`` — per-row, so one batch can mix both.
@@ -27,24 +43,34 @@ Sampling: greedy when temperature == 0, else softmax sampling at
 from __future__ import annotations
 
 import functools
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def make_decode_model(model):
+def make_decode_model(model, kv_page_size=None, kv_pool_pages=None):
     """Clone a (training-configured) TransformerLM into decode mode.
 
     Sharding attributes are stripped: decode is single-device (the
-    bridge re-gathers sharded checkpoints into full params first)."""
+    bridge re-gathers sharded checkpoints into full params first).
+    Remat is stripped too — there is no backward pass to save memory
+    for, and jax.checkpoint does not compose with the mutable cache.
+    ``kv_page_size``/``kv_pool_pages`` select the paged cache layout."""
     kw = {"decode": True}
     for attr in ("seq_axis", "model_axis"):
         if getattr(model, attr, None) is not None:
             kw[attr] = None
     if getattr(model, "shard_vocab", False):
         kw["shard_vocab"] = False
+    if getattr(model, "remat", False):
+        kw["remat"] = False
+    if getattr(model, "remat_policy", None) is not None:
+        kw["remat_policy"] = None
+    if kv_page_size is not None:
+        kw["kv_page_size"] = int(kv_page_size)
+        kw["kv_pool_pages"] = int(kv_pool_pages)
     return model.clone(**kw)
 
 
@@ -62,6 +88,22 @@ def init_cache(model, num_slots: int, max_seq_len: int):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
+def init_paged_cache(model, kv_page_size: int, kv_pool_pages: int):
+    """Zeros paged-cache pytree: a [kv_pool_pages, kv_page_size, H, Dh]
+    pool per layer per K/V.  Shapes come from an eval_shape of the
+    paged decode model's init (no params materialized)."""
+    decode_model = make_decode_model(model, kv_page_size=kv_page_size,
+                                     kv_pool_pages=kv_pool_pages)
+    tokens = jax.ShapeDtypeStruct((1, kv_page_size), jnp.int32)
+    idx = jax.ShapeDtypeStruct((1,), jnp.int32)
+    table = jax.ShapeDtypeStruct((1, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        functools.partial(decode_model.init, jax.random.key(0)),
+        tokens, cache_index=idx, block_table=table)["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+
 def _sample(logits, temperature, key):
     """logits [..., V] → token ids [...]; greedy at temperature 0."""
     greedy = jnp.argmax(logits, axis=-1)
@@ -75,10 +117,16 @@ class Decoder:
     """Jitted prefill/decode pair bound to one model + param set.
 
     ``params`` may include 'batch_stats' siblings conceptually, but the
-    LM family is LN-only — only 'params' is applied."""
+    LM family is LN-only — only 'params' is applied.
 
-    def __init__(self, model, params, *, num_slots: int, max_seq_len: int):
-        self.model = make_decode_model(model)
+    ``kv_page_size`` selects the paged cache (None = contiguous):
+    ``kv_pool_pages`` TOTAL pool pages including the scratch page 0
+    (None = full reservation, 1 + num_slots × pages-per-slot — the
+    engine shrinks it to provision for tokens in flight)."""
+
+    def __init__(self, model, params, *, num_slots: int, max_seq_len: int,
+                 kv_page_size: Optional[int] = None,
+                 kv_pool_pages: Optional[int] = None):
         self.params = params
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len)
@@ -86,10 +134,42 @@ class Decoder:
             raise ValueError(
                 f"max_seq_len {max_seq_len} exceeds the model's position "
                 f"table ({model.max_seq_len})")
-        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self.paged = kv_page_size is not None
+        if self.paged:
+            self.page_size = int(kv_page_size)
+            if self.page_size < 1:
+                raise ValueError(f"kv_page_size must be >= 1, got "
+                                 f"{kv_page_size}")
+            self.pages_per_slot = -(-self.max_seq_len // self.page_size)
+            self.pool_pages = int(
+                kv_pool_pages or 1 + self.num_slots * self.pages_per_slot)
+            if self.pool_pages < 2:
+                raise ValueError(
+                    f"kv_pool_pages must be >= 2 (page 0 is the scratch "
+                    f"page), got {self.pool_pages}")
+            self.model = make_decode_model(
+                model, kv_page_size=self.page_size,
+                kv_pool_pages=self.pool_pages)
+            # start / window_pages / flash_prefill are STATIC: they
+            # select the attention formulation and the gather extent,
+            # so the chunk body compiles once per (chunk shape, chunk
+            # index) — the "one compile per chunk shape" contract.
+            # What it buys: chunk c's attention gathers only the pages
+            # covering [0, start + C), so prefill work sums to
+            # O(prompt²/2) instead of chunks × the full window
+            self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1,),
+                                  static_argnums=(7, 8, 9))
+            self._decode = jax.jit(self._decode_paged_impl,
+                                   donate_argnums=(1,))
+        else:
+            self.model = make_decode_model(model)
+            self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
+            self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
 
     def fresh_cache(self):
+        if self.paged:
+            return init_paged_cache(self.model, self.page_size,
+                                    self.pool_pages)
         return init_cache(self.model, self.num_slots, self.max_seq_len)
 
     # -- jitted bodies -------------------------------------------------
@@ -127,11 +207,51 @@ class Decoder:
         toks = jax.vmap(_sample)(last, temperature, keys)
         return toks, mut["cache"], last
 
+    # -- paged jitted bodies -------------------------------------------
+    def _chunk_impl(self, params, cache, tokens, block_row, sample_pos,
+                    temperature, key, start, window_pages, flash_prefill):
+        """One prefill chunk.  tokens [1, C] (page-aligned, tail-padded
+        with zeros), block_row [1, M] the slot's page ids, sample_pos
+        scalar (offset WITHIN the chunk of the last real prompt token —
+        only read on the final chunk; earlier chunks' sampled token is
+        discarded by the engine).  ``start`` (the chunk's first logical
+        position), ``window_pages`` (pages covering [0, start + C)) and
+        ``flash_prefill`` (start == 0: causal-only via the flash
+        kernel) are static.  Returns (token, cache, sampled-position
+        logits)."""
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, tokens,
+            cache_index=jnp.full((1,), start, jnp.int32),
+            block_table=block_row, flash_prefill=flash_prefill,
+            window_pages=window_pages, mutable=["cache"])
+        last = jax.lax.dynamic_slice_in_dim(
+            logits[0], sample_pos, 1, axis=0)[0]           # [V]
+        tok = _sample(last, temperature, key)
+        return tok, mut["cache"], last
+
+    def _decode_paged_impl(self, params, cache, tokens, index,
+                           block_tables, temperature, key):
+        """tokens [B, 1], index [B], block_tables [B, M] — rows not in
+        decode phase carry an ALL-ZEROS block row, steering their
+        garbage write/gather at the scratch page (ops.paged_attention).
+        """
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, tokens,
+            cache_index=index, block_table=block_tables,
+            mutable=["cache"])
+        last = logits[:, -1]                               # [B, V]
+        keys = jax.random.split(key, last.shape[0])
+        toks = jax.vmap(_sample)(last, temperature, keys)
+        return toks, mut["cache"], last
+
     # -- public API ----------------------------------------------------
     def prefill(self, cache, prompt, slot: int, temperature: float,
                 key) -> Tuple[Any, Any, Any]:
         """prompt: 1-D int32 (unpadded).  Returns (token, cache, logits)
-        with the first sampled token as a device scalar."""
+        with the first sampled token as a device scalar.  Contiguous
+        mode only — paged prefill goes through :meth:`prefill_chunk`."""
+        if self.paged:
+            raise RuntimeError("paged Decoder: use prefill_chunk")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.shape[0] == 0:
             raise ValueError("prompt must be a non-empty 1-D token array")
@@ -147,13 +267,48 @@ class Decoder:
                              jnp.asarray(length, jnp.int32),
                              jnp.asarray(temperature, jnp.float32), key)
 
-    def decode_step(self, cache, tokens, index, temperature, key):
+    def prefill_chunk(self, cache, chunk, block_row, start: int,
+                      sample_pos: int, temperature: float, key):
+        """One page-aligned prefill chunk for one slot (paged mode).
+
+        chunk: 1-D int32, len(chunk) % page_size == 0 (engine-padded);
+        block_row: [M] int32 page ids for the slot; start: the chunk's
+        first logical position; sample_pos: offset within the chunk of
+        the last REAL prompt token (engine passes 0 for non-final
+        chunks and ignores the sampled token).  Returns (token, cache,
+        logits) — the first-chunk (start == 0) body routes attention
+        through the flash kernel; continuation chunks gather the paged
+        prefix."""
+        chunk = np.asarray(chunk, np.int32).reshape(1, -1)
+        if chunk.shape[1] % self.page_size or start % self.page_size:
+            raise ValueError(
+                f"prefill chunk (len {chunk.shape[1]}, start {start}) "
+                f"must be page-aligned (kv_page_size {self.page_size}) — "
+                f"whole-page writes depend on it")
+        block_row = np.asarray(block_row, np.int32).reshape(1, -1)
+        window = (int(start) + chunk.shape[1]) // self.page_size
+        return self._chunk(self.params, cache, jnp.asarray(chunk),
+                           jnp.asarray(block_row),
+                           jnp.asarray(sample_pos, jnp.int32),
+                           jnp.asarray(temperature, jnp.float32), key,
+                           int(start), window, start == 0)
+
+    def decode_step(self, cache, tokens, index, temperature, key,
+                    block_tables=None):
         """tokens [B], index [B], temperature [B] → (tokens [B], cache,
-        logits [B, V])."""
-        return self._decode(self.params, cache,
-                            jnp.asarray(tokens, jnp.int32).reshape(-1, 1),
-                            jnp.asarray(index, jnp.int32),
-                            jnp.asarray(temperature, jnp.float32), key)
+        logits [B, V]).  Paged mode additionally takes ``block_tables``
+        [B, M] (all-zeros rows for slots not decoding)."""
+        tokens = jnp.asarray(tokens, jnp.int32).reshape(-1, 1)
+        index = jnp.asarray(index, jnp.int32)
+        temperature = jnp.asarray(temperature, jnp.float32)
+        if self.paged:
+            if block_tables is None:
+                raise ValueError("paged decode_step needs block_tables")
+            return self._decode(self.params, cache, tokens, index,
+                                jnp.asarray(block_tables, jnp.int32),
+                                temperature, key)
+        return self._decode(self.params, cache, tokens, index,
+                            temperature, key)
 
 
 def teacher_forced_logits(model, params, tokens):

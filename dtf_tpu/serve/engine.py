@@ -21,6 +21,27 @@ with the standard production recipe:
       coexist, finish independently, and free their slot for the next
       queued request without draining the batch.
 
+With the PAGED KV cache (``kv_page_size``, the default) two more
+production levers land:
+
+  paged admission — HBM is a shared page pool (:class:`PagePool`), and
+      a request is admitted when its worst-case page count
+      (⌈(prompt + budget) / page_size⌉) is free — so concurrency is
+      bounded by TOKENS IN FLIGHT, not num_slots × max_seq_len.  A
+      pool sized at 50% of the contiguous reservation serves the same
+      slot count whenever mean request length < 50% of max_seq_len.
+      When the head of the queue cannot get pages it WAITS (FIFO —
+      large requests are not starved by small ones slipping past);
+      retiring slots free their pages for the next admit.
+  chunked prefill — prompts prefill in ``prefill_chunk``-token
+      page-aligned chunks, ONE chunk per engine iteration, with a
+      decode step for running slots between chunks — a max-length
+      prompt adds bounded (chunk-sized) gaps to running decodes
+      instead of head-of-line-blocking them for the whole prompt.
+      The first chunk of every prompt runs pure causal self-attention
+      through the flash kernel (no cache gather at all), so short
+      prompts — the common case — never touch the gather path.
+
 Single engine thread owns ALL device work (prefill, decode, sampling);
 ``submit`` only enqueues — so there is no cross-thread jit contention.
 Each decode step syncs the sampled tokens to the host (the EOS/budget
@@ -108,12 +129,65 @@ class _Handle:
         self._event.set()
 
 
+class PagePool:
+    """Host-side free-list allocator over the shared KV page pool.
+
+    Page 0 is the SCRATCH page — never handed to a request.  Inactive
+    rows of the fixed-shape decode batch carry all-zeros block-table
+    rows, so their garbage writes/gathers land there and can never
+    touch a live sequence (ops.paged_attention has the full invariant).
+    ``high_water`` records the peak pages in use — the number that
+    proves retired pages are actually reclaimed and reused."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(f"page pool needs >= 2 pages (page 0 is "
+                             f"scratch), got {num_pages}")
+        self.num_pages = int(num_pages)
+        # LIFO free stack: a just-retired request's pages go to the
+        # next admit — maximally warm reuse, and the reclamation tests
+        # can assert the high-water mark stays at the concurrent need
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self.high_water = 0
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.usable_pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None when the pool cannot cover them (caller
+        waits for a retire — never a partial grant)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self.high_water = max(self.high_water, self.used_pages)
+        return pages
+
+    def free(self, pages: List[int]):
+        self._free.extend(pages)
+
+
 @dataclasses.dataclass
 class _Slot:
     handle: _Handle
     tokens: List[int]                   # generated so far
     last_token: int                     # next decode step's input
     index: int                          # current sequence length
+    phase: str = "decode"               # "prefill" until the prompt is in
+    # paged mode:
+    pages: Optional[List[int]] = None   # pool pages owned by this slot
+    block_row: Optional[np.ndarray] = None  # [M] int32 page ids
+    prompt_padded: Optional[np.ndarray] = None  # page-aligned prompt
+    chunk_plan: Optional[List] = None   # [(start, len), ...]
+    chunk_i: int = 0                    # next chunk to run
 
 
 class ServeEngine:
@@ -121,20 +195,58 @@ class ServeEngine:
 
     ``model`` is a TransformerLM (training configuration); ``params``
     its param pytree (from serve.bridge).  ``max_seq_len`` bounds
-    prompt + generation per request and fixes the cache shapes."""
+    prompt + generation per request and fixes the cache shapes.
+
+    ``kv_page_size`` selects the paged KV cache (the default; 0/None =
+    the contiguous per-slot layout).  ``kv_pool_pages`` sizes the
+    shared pool in TOTAL pages incl. the scratch page (0/None = the
+    full contiguous-equivalent reservation; size it down to provision
+    for actual tokens in flight).  ``prefill_chunk`` is the chunked-
+    prefill unit in tokens (multiple of the page size; 0 = whole
+    prompts prefill as one page-aligned chunk; None = the default,
+    4 pages)."""
 
     def __init__(self, model, params, *, max_batch: int = 8,
                  max_seq_len: Optional[int] = None,
                  max_delay_s: float = 0.005, queue_size: int = 64,
-                 seed: int = 0):
+                 seed: int = 0, kv_page_size: Optional[int] = 16,
+                 kv_pool_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None):
         if max_batch < 1 or queue_size < 1:
             raise ValueError("max_batch and queue_size must be >= 1")
         self.max_batch = int(max_batch)
         self.max_seq_len = int(max_seq_len or model.max_seq_len)
         self.max_delay_s = float(max_delay_s)
         self.queue_size = int(queue_size)
-        self.decoder = Decoder(model, params, num_slots=self.max_batch,
-                               max_seq_len=self.max_seq_len)
+        self.paged = bool(kv_page_size)
+        if self.paged:
+            self.page_size = int(kv_page_size)
+            # None = default (4 pages — 64 tokens at the default page
+            # size, and a page multiple at ANY page size); 0 = whole-
+            # prompt single chunks
+            self.prefill_chunk = (4 * self.page_size if prefill_chunk
+                                  is None else int(prefill_chunk))
+            if self.prefill_chunk and self.prefill_chunk % self.page_size:
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must be a "
+                    f"multiple of kv_page_size ({self.page_size})")
+            self.decoder = Decoder(
+                model, params, num_slots=self.max_batch,
+                max_seq_len=self.max_seq_len,
+                kv_page_size=self.page_size,
+                kv_pool_pages=(int(kv_pool_pages) if kv_pool_pages
+                               else None))
+            self.pool = PagePool(self.decoder.pool_pages)
+        else:
+            # None is the only "unset" value — an explicit chunk size
+            # (including 0) with the contiguous cache is a
+            # contradiction, rejected loudly regardless of its value
+            if kv_pool_pages or prefill_chunk is not None:
+                raise ValueError("kv_pool_pages / prefill_chunk need the "
+                                 "paged cache (kv_page_size > 0)")
+            self.decoder = Decoder(model, params, num_slots=self.max_batch,
+                                   max_seq_len=self.max_seq_len)
+            self.pool = None
         self._cache = self.decoder.fresh_cache()
         self._key = jax.random.key(seed)
 
@@ -170,6 +282,23 @@ class ServeEngine:
             "serve_queue_depth_sampled", unit="requests")
         self._m_occ_sampled = self.metrics.histogram(
             "serve_slot_occupancy_sampled", unit="fraction")
+        # paged-cache operational signals: pool occupancy (gauge + per-
+        # iteration samples), prefill chunks run, and the decode-step
+        # GAP — wall time between consecutive decode steps while slots
+        # are decoding.  The gap p99 is the head-of-line-blocking
+        # number chunked prefill exists to bound (bench_serve.py reads
+        # it for the chunked vs un-chunked comparison).
+        self._m_pages_used = self.metrics.gauge("serve_kv_pages_used",
+                                                unit="pages")
+        self._m_pages_sampled = self.metrics.histogram(
+            "serve_kv_pages_used_sampled", unit="pages")
+        self._m_prefill_chunks = self.metrics.counter(
+            "serve_prefill_chunks_total", unit="chunks")
+        self._m_decode_gap = self.metrics.histogram("serve_decode_gap_s",
+                                                    unit="s")
+        self._last_step_t: Optional[float] = None
+        self._prefill_rr = -1           # round-robin cursor (chunk sched)
+        self.max_concurrent = 0         # peak simultaneously-active slots
         self._ewma_latency = 0.25       # seed estimate for retry_after
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-engine")
@@ -180,6 +309,22 @@ class ServeEngine:
         """Total requests shed (single source of truth: the registry
         counter the benchmark export reads)."""
         return self._m_shed.value
+
+    def reset_measurement(self) -> int:
+        """Zero the peak/distribution measurement state (decode-gap
+        histogram, peak concurrency, pool high-water) under the engine
+        lock, and return the current completed-request count — the
+        slice point for post-warmup stats.  Benches call this after
+        their warmup traffic drains so compile time and idle spans
+        don't masquerade as serving behavior; holding ``_cond`` keeps
+        the reset from racing the engine thread's own peak updates."""
+        with self._cond:
+            self._m_decode_gap.reset()
+            self._last_step_t = None
+            self.max_concurrent = 0
+            if self.pool is not None:
+                self.pool.high_water = self.pool.used_pages
+            return len(self.completed)
 
     # -- client side ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -198,6 +343,15 @@ class ServeEngine:
                 f"max_new_tokens ({max_new_tokens}) = {total} exceeds "
                 f"max_seq_len {self.max_seq_len}; shorten the prompt or "
                 f"lower the budget")
+        if self.paged:
+            need = -(-total // self.page_size)
+            if need > self.pool.usable_pages:
+                raise ValueError(
+                    f"oversized request for the page pool: needs {need} "
+                    f"pages of {self.page_size} tokens but the pool has "
+                    f"{self.pool.usable_pages} usable — it could never "
+                    f"be admitted; grow --kv_pool_pages or shrink the "
+                    f"request")
         req = ServeRequest(prompt=prompt, max_new_tokens=int(max_new_tokens),
                            temperature=float(temperature), eos_id=eos_id)
         handle = _Handle(req)
@@ -261,6 +415,10 @@ class ServeEngine:
                 if not self._pending and not active:
                     if self._stop.is_set():
                         return
+                    # idle: the next decode step's gap would span this
+                    # wait, which is queue emptiness, not head-of-line
+                    # blocking — don't let it poison the gap histogram
+                    self._last_step_t = None
                     # empty queue: sleep until a submit (or stop) pokes us
                     self._cond.wait(timeout=0.1)
                     continue
@@ -277,55 +435,163 @@ class ServeEngine:
                 admitted = []
                 for i, slot in enumerate(self._slots):
                     if slot is None and self._pending:
-                        admitted.append((i, self._pending.pop(0)))
+                        pages = None
+                        if self.paged:
+                            req = self._pending[0].request
+                            need = self._pages_needed(req)
+                            pages = self.pool.alloc(need)
+                            if pages is None:
+                                # head-of-line FIFO wait: the next
+                                # retire frees pages; small requests do
+                                # NOT slip past a starved big one
+                                break
+                        admitted.append((i, self._pending.pop(0), pages))
                 self._m_queue_depth.set(len(self._pending))
             if self._stop.is_set() and not any(
                     s is not None for s in self._slots) and not admitted:
                 return
             if admitted:
-                # batch formation: prefill each admitted request into
-                # its slot (the fill-the-batch phase of the recipe)
+                # batch formation: bind each admitted request to its
+                # slot (contiguous: full prefill here; paged: allocate +
+                # plan chunks, prefill advances below — interleaved)
                 with trace.span("serve_batch_form", admitted=len(admitted)):
-                    for i, handle in admitted:
-                        self._admit(i, handle)
+                    for i, handle, pages in admitted:
+                        self._admit(i, handle, pages)
                 self._m_admitted.inc(len(admitted))
+            # chunked prefill: ONE chunk per iteration TOTAL (round-
+            # robin across prefilling slots), so the gap running
+            # decodes see is bounded by a single chunk's compute no
+            # matter how many prompts are prefilling concurrently
+            prefilling = [i for i, s in enumerate(self._slots)
+                          if s is not None and s.phase == "prefill"]
+            if prefilling:
+                nxt = next((i for i in prefilling
+                            if i > self._prefill_rr), prefilling[0])
+                self._advance_prefill(nxt)
+                self._prefill_rr = nxt
             active = sum(s is not None for s in self._slots)
+            decoding = sum(s is not None and s.phase == "decode"
+                           for s in self._slots)
+            self.max_concurrent = max(self.max_concurrent, active)
             self._m_occupancy.set(active / self.max_batch)
+            if self.paged:
+                self._m_pages_used.set(self.pool.used_pages)
             if active:
                 self._m_occ_sampled.observe(active / self.max_batch)
                 self._m_queue_sampled.observe(len(self._pending))
+                if self.paged:
+                    self._m_pages_sampled.observe(self.pool.used_pages)
+            if decoding:
                 self._step()
+            else:
+                # no running decodes: the next decode-step gap is not a
+                # head-of-line measurement
+                self._last_step_t = None
 
-    def _admit(self, slot_idx: int, handle: _Handle):
+    def _pages_needed(self, req: ServeRequest) -> int:
+        """Worst-case pages for a request: prompt + full budget.
+        Reserving up front means a decode step can never OOM the pool
+        mid-generation (no preemption machinery needed)."""
+        total = int(req.prompt.size) + int(req.max_new_tokens)
+        return -(-total // self.page_size)
+
+    def _chunk_plan(self, plen: int):
+        """[(start, len), ...] page-aligned chunks covering the prompt.
+        Full ``prefill_chunk``-token chunks, then one final chunk padded
+        to the page size (so the final chunk always contains the last
+        real prompt token — the sampled position).  prefill_chunk == 0:
+        the whole prompt is one page-aligned chunk."""
+        chunk = self.prefill_chunk or -(-plen // self.page_size) * \
+            self.page_size
+        plan, start = [], 0
+        while plen - start > chunk:
+            plan.append((start, chunk))
+            start += chunk
+        rem = plen - start
+        plan.append((start, -(-rem // self.page_size) * self.page_size))
+        return plan
+
+    def _admit(self, slot_idx: int, handle: _Handle,
+               pages: Optional[List[int]]):
         req = handle.request
         req.admit_time = time.time()
+        if not self.paged:
+            self._key, sub = jax.random.split(self._key)
+            tok, self._cache, _ = self.decoder.prefill(
+                self._cache, req.prompt, slot_idx, req.temperature, sub)
+            first = int(tok)
+            req.first_token_time = time.time()
+            slot = _Slot(handle=handle, tokens=[first], last_token=first,
+                         index=int(req.prompt.size))
+            self._slots[slot_idx] = slot
+            if self._finished(slot):
+                self._retire(slot_idx)
+            return
+        plen = int(req.prompt.size)
+        plan = self._chunk_plan(plen)
+        padded_len = plan[-1][0] + plan[-1][1]
+        prompt_padded = np.zeros((padded_len,), np.int32)
+        prompt_padded[:plen] = req.prompt
+        block_row = np.zeros((self.decoder.pages_per_slot,), np.int32)
+        block_row[:len(pages)] = pages
+        self._slots[slot_idx] = _Slot(
+            handle=handle, tokens=[], last_token=0, index=0,
+            phase="prefill", pages=pages, block_row=block_row,
+            prompt_padded=prompt_padded, chunk_plan=plan, chunk_i=0)
+
+    def _advance_prefill(self, slot_idx: int):
+        slot = self._slots[slot_idx]
+        req = slot.handle.request
+        start, clen = slot.chunk_plan[slot.chunk_i]
+        is_last = slot.chunk_i == len(slot.chunk_plan) - 1
+        plen = int(req.prompt.size)
+        sample_pos = plen - 1 - start if is_last else 0
         self._key, sub = jax.random.split(self._key)
-        tok, self._cache, _ = self.decoder.prefill(
-            self._cache, req.prompt, slot_idx, req.temperature, sub)
-        first = int(tok)
-        req.first_token_time = time.time()
-        slot = _Slot(handle=handle, tokens=[first], last_token=first,
-                     index=int(req.prompt.size))
-        self._slots[slot_idx] = slot
-        if self._finished(slot):
-            self._retire(slot_idx)
+        with trace.span("serve_prefill_chunk", slot=slot_idx, start=start,
+                        tokens=clen, last=is_last):
+            tok, self._cache, _ = self.decoder.prefill_chunk(
+                self._cache, slot.prompt_padded[start:start + clen],
+                slot.block_row, start, sample_pos, req.temperature, sub)
+        self._m_prefill_chunks.inc()
+        slot.chunk_i += 1
+        if is_last:
+            first = int(tok)
+            req.first_token_time = time.time()
+            slot.tokens = [first]
+            slot.last_token = first
+            slot.index = plen
+            slot.phase = "decode"
+            if self._finished(slot):
+                self._retire(slot_idx)
 
     def _step(self):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._m_decode_gap.observe(now - self._last_step_t)
         tokens = np.zeros((self.max_batch,), np.int32)
         index = np.zeros((self.max_batch,), np.int32)
         temps = np.zeros((self.max_batch,), np.float32)
+        tables = None
+        if self.paged:
+            tables = np.zeros((self.max_batch,
+                               self.decoder.pages_per_slot), np.int32)
         for i, s in enumerate(self._slots):
-            if s is not None:
+            if s is not None and s.phase == "decode":
                 tokens[i] = s.last_token
                 index[i] = s.index
                 temps[i] = s.handle.request.temperature
+                if tables is not None:
+                    # prefilling / empty rows keep all-zeros rows →
+                    # their garbage goes to the scratch page
+                    tables[i] = s.block_row
         self._key, sub = jax.random.split(self._key)
         with trace.span("serve_decode"):
             out, self._cache, _ = self.decoder.decode_step(
-                self._cache, tokens, index, temps, sub)
+                self._cache, tokens, index, temps, sub,
+                block_tables=tables)
             out = np.asarray(out)
         for i, s in enumerate(self._slots):
-            if s is None:
+            if s is None or s.phase != "decode":
                 continue
             tok = int(out[i])
             s.tokens.append(tok)
@@ -333,6 +599,7 @@ class ServeEngine:
             s.index += 1
             if self._finished(s):
                 self._retire(i)
+        self._last_step_t = time.perf_counter()
 
     @staticmethod
     def _finished(slot: _Slot) -> bool:
@@ -344,6 +611,9 @@ class ServeEngine:
     def _retire(self, slot_idx: int):
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
+        if slot.pages:
+            # reclaim: these exact pages are the next admit's grant
+            self.pool.free(slot.pages)
         req = slot.handle.request
         req.finish_time = time.time()
         result = ServeResult(

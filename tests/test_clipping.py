@@ -54,6 +54,7 @@ def _flat(params):
     return dict(jax.tree_util.tree_leaves_with_path(params))
 
 
+@pytest.mark.slow
 def test_clip_is_exact_under_tensor_parallelism(eight_devices):
     """Same clip threshold, same data: TP-updated params ≡ unsharded
     updated params (wrong norm accounting would scale the update)."""

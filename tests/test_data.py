@@ -1,6 +1,7 @@
 """Data layer tests: synthetic backend parity + device prefetcher."""
 
 import numpy as np
+import pytest
 
 from dtf_tpu.config import Config
 from dtf_tpu.data import DevicePrefetcher, get_dataset_spec, synthetic_input_fn
@@ -73,3 +74,21 @@ def test_device_prefetcher_propagates_errors():
     except RuntimeError:
         raised = True
     assert raised
+    # the error is LATCHED: every subsequent __next__ re-raises the
+    # same exception instead of blocking forever on the drained queue
+    for _ in range(2):
+        with pytest.raises(RuntimeError, match="reader died"):
+            next(pf)
+
+
+def test_device_prefetcher_stop_iteration_latched():
+    """A cleanly-exhausted prefetcher keeps raising StopIteration (the
+    iterator protocol's contract) rather than wedging."""
+    cfg = Config(distribution_strategy="off")
+    rt = initialize(cfg)
+    data = [(np.ones((2, 4, 4, 3), np.float32), np.zeros((2,), np.int32))]
+    pf = DevicePrefetcher(iter(data), rt)
+    assert len(list(pf)) == 1
+    for _ in range(2):
+        with pytest.raises(StopIteration):
+            next(pf)

@@ -68,8 +68,16 @@ def test_causality():
                                 dict(remat_policy="dots")])
 def test_remat_variants_match_baseline(kw):
     """remat and remat_policy change what is saved between forward and
-    backward, never the math: loss and every gradient leaf must match
-    the no-remat model exactly."""
+    backward, never the math — but they DO change which values XLA
+    recomputes vs reloads, and on jax 0.4.37/CPU the recomputed
+    elementwise chains fuse differently, reordering f32 accumulations.
+    Loss must still match exactly (the forward graph is identical);
+    gradients are compared at an ulp-scale tolerance: observed drift is
+    ≤ 3e-8 absolute (≈ a few ulps at the ~0.05 gradient magnitudes
+    here, f32 eps = 1.19e-7), so atol 1e-7 + rtol 1e-6 admits
+    accumulation-order noise and nothing else — a real math divergence
+    (wrong policy residual, dropped term) is orders of magnitude
+    larger."""
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, 64, (2, 16)).astype(np.int32))
 
@@ -91,8 +99,9 @@ def test_remat_variants_match_baseline(kw):
             jax.tree_util.tree_leaves_with_path(base_grads),
             jax.tree_util.tree_leaves_with_path(got_grads)):
         assert pa == pb
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
-                                      err_msg=jax.tree_util.keystr(pa))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7,
+                                   err_msg=jax.tree_util.keystr(pa))
 
 
 def test_remat_policy_unknown_name_raises():

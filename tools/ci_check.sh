@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# One-command CI contract: tier-1 suite + test-budget audit + traced
+# smoke run + anomaly cleanliness.
+#
+# Before this script the repo had two CONVENTIONS instead of one
+# command: "run tools/marker_audit.py after the suite" (the test-budget
+# contract — no unmarked test over the per-test ceiling) and "run
+# trace_main --check on a traced run" (the anomaly-cleanliness
+# contract — no NaN/step-time/shed anomalies in a healthy smoke run).
+# Conventions rot; this script is the executable form:
+#
+#   1. tier-1 pytest (ROADMAP command shape: CPU, -m 'not slow'),
+#      which also writes tests/.last_durations.json via the conftest
+#      hook.  Skip with CI_CHECK_SKIP_TESTS=1 when iterating on the
+#      later stages.
+#   2. tools/marker_audit.py over that durations dump.
+#   3. a traced synthetic-data smoke train run (tiny step count) with
+#      --trace_dir into a temp dir.
+#   4. python -m dtf_tpu.cli.trace_main <dir> --check — exits nonzero
+#      on ANY anomaly record (nan_loss, step_time_regression,
+#      serve_shed, ...).
+#
+# Usage: tools/ci_check.sh            # the full contract
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-4 only
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
+    echo "== ci_check [1/4]: tier-1 test suite =="
+    timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly
+else
+    echo "== ci_check [1/4]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+fi
+
+echo "== ci_check [2/4]: marker audit (test-budget contract) =="
+python tools/marker_audit.py
+
+echo "== ci_check [3/4]: traced smoke run =="
+TRACE_DIR=$(mktemp -d)
+trap 'rm -rf "$TRACE_DIR"' EXIT
+python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
+    --batch_size 4 --model transformer_small --seq_len 64 \
+    --model_dir "$TRACE_DIR/run" --skip_checkpoint \
+    --trace_dir "$TRACE_DIR" >/dev/null
+
+echo "== ci_check [4/4]: anomaly cleanliness =="
+python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
+
+echo "ci_check: OK"
