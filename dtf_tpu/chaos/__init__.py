@@ -1,0 +1,300 @@
+"""Deterministic fault injection — the chaos layer.
+
+On TPU pods preemption and rank loss are routine; a recovery story that
+is never exercised is a recovery story that does not work.  This
+package turns "kill a process and see" into a first-class, reproducible
+experiment: a fault spec names exactly which failure fires, on which
+rank, at which point of the run — and the test suite / CI chaos smoke
+stage replays it deterministically.
+
+Shaped like ``obs/trace``: a module-level injector that is ``None``
+unless configured, so every probe is a single attribute read + compare
+when chaos is off — provably no behavior or cost on production runs
+(pinned by tests/test_chaos.py).
+
+Spec grammar (``--fault`` flag or the ``DTF_FAULT`` env var the
+launcher forwards; comma-separated specs compose)::
+
+    spec  := kind "@" [ "rank" INT ":" ] point
+    point := "step" ":" INT | "version" ":" INT | "latest"
+
+Kinds and their firing semantics:
+
+  crash@step:N            hard process death (os._exit) at the train
+                          step-N boundary — fires on EXACT step match,
+                          so a run resumed at/past N does not re-die.
+                          Exit code EXIT_INJECTED_CRASH (77): the
+                          supervisor classifies it as a budgeted crash.
+  sigterm@step:N          delivers SIGTERM to the process itself at the
+                          step-N boundary (exact match) — exercises the
+                          preemption path: emergency checkpoint +
+                          EXIT_PREEMPTED (75) + unbudgeted restart.
+  heartbeat_stall@step:N  from step N on, heartbeat files silently stop
+                          being written (latched) — the deadlocked-but-
+                          alive signature the supervisor's heartbeat
+                          watchdog exists to catch.
+  ps_drop@version:N       the PS client closes its store connection
+                          once its observed store version reaches N
+                          (one-shot) — exercises reconnect + backoff.
+  ckpt_truncate@latest    truncates a payload file of the NEWEST
+                          checkpoint step before the next restore
+                          (one-shot) — exercises the integrity manifest
+                          fallback to the previous verified step.
+
+Every fired fault emits a structured ``injected_fault`` anomaly record
+through obs.trace (flushed before dying), so
+``trace_main --check --allow injected_fault`` can assert a chaos run
+contained the injected fault and nothing else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+log = logging.getLogger("dtf_tpu")
+
+# Exit-code contract with the launch.py supervisor (which is stdlib-only
+# by design and carries its own copy; parity is test-pinned).
+EXIT_PREEMPTED = 75        # EX_TEMPFAIL: graceful preemption checkpoint
+EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
+
+KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate")
+_POINTS = {
+    "crash": "step",
+    "sigterm": "step",
+    "heartbeat_stall": "step",
+    "ps_drop": "version",
+    "ckpt_truncate": "latest",
+}
+
+_injector: Optional["Injector"] = None
+_lock = threading.Lock()
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    rank: Optional[int]     # None = every rank
+    value: Optional[int]    # None for point "latest"
+    fired: bool = False
+
+    @property
+    def point(self) -> str:
+        return _POINTS[self.kind]
+
+    def __str__(self) -> str:
+        r = f"rank{self.rank}:" if self.rank is not None else ""
+        p = "latest" if self.value is None else f"{self.point}:{self.value}"
+        return f"{self.kind}@{r}{p}"
+
+
+def parse_spec(text: str) -> List[FaultSpec]:
+    """Parse a comma-separated fault spec string; raises ValueError with
+    the offending token on any grammar violation (a typo'd fault that
+    silently never fires would invalidate the whole experiment)."""
+    out: List[FaultSpec] = []
+    for tok in (t.strip() for t in text.split(",")):
+        if not tok:
+            continue
+        if "@" not in tok:
+            raise ValueError(f"fault spec {tok!r}: expected kind@point")
+        kind, _, point = tok.partition("@")
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault spec {tok!r}: unknown kind {kind!r} "
+                f"(choose from {KINDS})")
+        rank: Optional[int] = None
+        if point.startswith("rank"):
+            rtok, _, point = point.partition(":")
+            try:
+                rank = int(rtok[4:])
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {tok!r}: bad rank selector {rtok!r}")
+        want = _POINTS[kind]
+        if want == "latest":
+            if point != "latest":
+                raise ValueError(
+                    f"fault spec {tok!r}: {kind} takes the point 'latest'")
+            out.append(FaultSpec(kind, rank, None))
+            continue
+        sel, _, val = point.partition(":")
+        if sel != want or not val:
+            raise ValueError(
+                f"fault spec {tok!r}: {kind} takes '{want}:<int>'")
+        try:
+            value = int(val)
+        except ValueError:
+            raise ValueError(f"fault spec {tok!r}: {val!r} is not an int")
+        if value < 0:
+            raise ValueError(f"fault spec {tok!r}: value must be >= 0")
+        out.append(FaultSpec(kind, rank, value))
+    return out
+
+
+class Injector:
+    """Holds the armed fault specs for THIS rank and fires them at the
+    probe points.  Each spec fires at most once per process."""
+
+    def __init__(self, specs: List[FaultSpec], rank: int = 0):
+        self.rank = int(rank)
+        self.specs = [s for s in specs
+                      if s.rank is None or s.rank == self.rank]
+        self._mu = threading.Lock()
+
+    def _armed(self, kind: str):
+        return [s for s in self.specs if s.kind == kind and not s.fired]
+
+    # -- firing helpers -------------------------------------------------
+    def _record(self, spec: FaultSpec, **attrs) -> None:
+        # lazy import: chaos stays stdlib-light so the supervisor-side
+        # tests and early process bootstrap can import it freely
+        from dtf_tpu.obs import trace
+        spec.fired = True
+        log.error("chaos: firing injected fault %s %s", spec, attrs)
+        # "fault_kind", not "kind": the record's own "kind" field is the
+        # span/event/anomaly discriminator and must not be clobbered
+        trace.anomaly("injected_fault", fault=str(spec),
+                      fault_kind=spec.kind, **attrs)
+        trace.flush()
+
+    # -- probe points ---------------------------------------------------
+    def step(self, step: int) -> None:
+        """Train/PS-worker step-boundary probe.  EXACT-match semantics:
+        a resumed run whose restored step is at/past the fault value
+        must not re-fire it (or a deterministic fault would crash-loop
+        the supervisor's whole restart budget away)."""
+        step = int(step)
+        with self._mu:
+            for spec in self._armed("crash"):
+                if step == spec.value:
+                    self._record(spec, step=step)
+                    # emulate hard death: no atexit, no finally blocks —
+                    # exactly what a segfault/OOM-kill looks like to the
+                    # supervisor (minus this distinct exit code)
+                    os._exit(EXIT_INJECTED_CRASH)
+            for spec in self._armed("sigterm"):
+                if step == spec.value:
+                    self._record(spec, step=step)
+                    # the preemption signal, delivered for real so the
+                    # actual production handler path runs
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+    def heartbeat_stalled(self, step: Optional[int]) -> bool:
+        """True once a heartbeat_stall fault latched (permanent: a
+        deadlocked rank does not recover by itself)."""
+        with self._mu:
+            for spec in self.specs:
+                if spec.kind != "heartbeat_stall":
+                    continue
+                if spec.fired:
+                    return True
+                if step is not None and int(step) >= spec.value:
+                    self._record(spec, step=int(step))
+                    return True
+        return False
+
+    def ps_drop(self, version: int) -> bool:
+        """One-shot: True when the PS client should drop its connection
+        (observed store version reached the spec value)."""
+        with self._mu:
+            for spec in self._armed("ps_drop"):
+                if int(version) >= spec.value:
+                    self._record(spec, version=int(version))
+                    return True
+        return False
+
+    def ckpt_truncate(self) -> bool:
+        """One-shot: True when the next restore should first truncate
+        the newest checkpoint step (the torn-write simulation)."""
+        with self._mu:
+            for spec in self._armed("ckpt_truncate"):
+                self._record(spec)
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Module-level API (what instrumented code calls) — every probe is a
+# None-check when chaos is off.
+# ---------------------------------------------------------------------------
+
+def configure(spec: str, rank: Optional[int] = None) -> Injector:
+    """Arm the process-global injector.  Reconfiguring replaces it."""
+    global _injector
+    if rank is None:
+        rank = int(os.environ.get("DTF_PROCESS_ID", "0"))
+    specs = parse_spec(spec)
+    with _lock:
+        _injector = Injector(specs, rank=rank)
+    if specs:
+        log.warning("chaos armed (rank %d): %s", rank,
+                    ", ".join(str(s) for s in _injector.specs) or
+                    "(no spec targets this rank)")
+    return _injector
+
+
+def maybe_configure(cfg=None) -> Optional[Injector]:
+    """Arm from ``cfg.fault`` or the ``DTF_FAULT`` env var.  When
+    neither is set chaos is DISARMED (not merely left alone): a fault
+    armed by a previous run in the same process must never leak into a
+    run that did not ask for one.  Explicit config wins over env."""
+    spec = (getattr(cfg, "fault", "") or os.environ.get("DTF_FAULT", ""))
+    if not spec:
+        disable()
+        return None
+    rank = getattr(cfg, "process_id", None) if cfg is not None else None
+    return configure(spec, rank=rank)
+
+
+def get() -> Optional[Injector]:
+    return _injector
+
+
+def enabled() -> bool:
+    return _injector is not None
+
+
+def disable() -> None:
+    """Disarm (tests)."""
+    global _injector
+    with _lock:
+        _injector = None
+
+
+def step(step_value: int) -> None:
+    inj = _injector
+    if inj is None:
+        return
+    inj.step(step_value)
+
+
+def heartbeat_stalled(step_value: Optional[int]) -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.heartbeat_stalled(step_value)
+
+
+def ps_drop(version: int) -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.ps_drop(version)
+
+
+def ckpt_truncate() -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.ckpt_truncate()
+
+
+if sys.platform == "win32":  # pragma: no cover - posix repo, belt+braces
+    raise ImportError("dtf_tpu.chaos needs posix signals")

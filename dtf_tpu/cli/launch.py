@@ -28,6 +28,7 @@ launcher exits non-zero.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import shlex
@@ -44,6 +45,45 @@ from typing import List, Optional
 # is the package-init shard_map shim's jax import, a fixed ~3 s).
 # tests/test_obs.py asserts the two sides agree on the contract.
 HEARTBEAT_DIR_ENV = "DTF_HEARTBEAT_DIR"
+
+# Exit-code contract with dtf_tpu/train/preemption.py and dtf_tpu/chaos
+# — duplicated here for the same stdlib-only reason (parity is pinned
+# by tests/test_chaos.py).  A rank exiting EXIT_PREEMPTED performed a
+# graceful preemption checkpoint: the supervisor restarts it WITHOUT
+# consuming the crash-restart budget and without backoff (the work is
+# durable; waiting helps nobody).  Any other nonzero exit (including
+# death by signal — negative Popen returncodes) is a crash: budgeted,
+# with exponential backoff.
+EXIT_PREEMPTED = 75
+
+
+def classify_exit(rc: int) -> str:
+    if rc == 0:
+        return "ok"
+    if rc == EXIT_PREEMPTED:
+        return "preempted"
+    return "crash"
+
+
+class SupervisorEventLog:
+    """Append-only ``supervisor_events.jsonl`` in the log dir: one JSON
+    record per supervision decision (rank exits with classification,
+    heartbeat kills, restarts with backoff + budget state, give-ups) —
+    post-mortems read this instead of scraping log{N}.retry{M}.log
+    filenames.  Best-effort: a full disk must not take down the
+    supervisor with the job."""
+
+    def __init__(self, log_dir: str):
+        self.path = os.path.join(log_dir, "supervisor_events.jsonl")
+
+    def emit(self, event: str, **attrs) -> None:
+        rec = {"ts": time.time(), "event": event}
+        rec.update(attrs)
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
 
 
 def heartbeat_path(directory: str, rank: int) -> str:
@@ -84,8 +124,19 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
               log_dir: str, devices_per_process: Optional[int],
               stagger_s: float = 0.0,
               heartbeat_timeout: Optional[float] = None,
-              attempt: int = 0, startup_grace: float = 300.0) -> int:
+              attempt: int = 0, startup_grace: float = 300.0,
+              events: Optional[SupervisorEventLog] = None,
+              teardown_grace: float = 60.0) -> int:
     os.makedirs(log_dir, exist_ok=True)
+    if events is None:
+        events = SupervisorEventLog(log_dir)
+    events.emit("attempt_start", attempt=attempt, ranks=num_processes)
+    # teardown escalation state: once a failure SIGTERMs the survivors,
+    # they get `teardown_grace` seconds to emergency-checkpoint and
+    # exit; a rank wedged in a dead collective (or ignoring SIGTERM)
+    # is then hard-killed — without this the monitor loop would wait
+    # on it forever (the finally's kill only runs after the loop ends)
+    term_at: Optional[float] = None
     procs = []  # (rank, Popen)
     logs = []
     rc = 0
@@ -176,17 +227,39 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                                   f"({heartbeat_timeout:.0f}s without "
                                   f"{'a heartbeat' if hb_ts[rank] is not None else 'log output'}"
                                   f"); killing", file=sys.stderr)
+                            events.emit("heartbeat_lost", attempt=attempt,
+                                        rank=rank,
+                                        timeout_s=heartbeat_timeout)
                             p.kill()
                     continue
                 procs.remove((rank, p))
+                events.emit("rank_exit", attempt=attempt, rank=rank,
+                            code=ret, classification=classify_exit(ret),
+                            log=log_path(rank))
                 if ret != 0:
                     if rc == 0:  # keep the FIRST failure's code
                         rc = ret
-                    print(f"rank {rank} exited {ret} (see "
+                    print(f"rank {rank} exited {ret} "
+                          f"({classify_exit(ret)}; see "
                           f"{log_path(rank)}); tearing down",
                           file=sys.stderr)
-                    for _, q in procs:  # kill.sh parity
+                    for _, q in procs:  # kill.sh parity — SIGTERM first
+                        # so dtf mains can emergency-checkpoint (the
+                        # preemption path); hard kill after
+                        # teardown_grace below
                         q.send_signal(signal.SIGTERM)
+                    if term_at is None:
+                        term_at = time.monotonic()
+            if (term_at is not None and procs
+                    and time.monotonic() - term_at > teardown_grace):
+                for r2, q in procs:
+                    print(f"rank {r2} still alive {teardown_grace:.0f}s "
+                          f"after teardown SIGTERM; killing",
+                          file=sys.stderr)
+                    events.emit("teardown_kill", attempt=attempt, rank=r2,
+                                grace_s=teardown_grace)
+                    q.kill()
+                term_at = None  # killed; the loop reaps their exits
             time.sleep(0.2)
     finally:
         for _, q in procs:
@@ -200,26 +273,98 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
                  log_dir: str, devices_per_process: Optional[int],
                  stagger_s: float = 0.0, max_restarts: int = 0,
                  heartbeat_timeout: Optional[float] = None,
-                 startup_grace: float = 300.0) -> int:
-    """Run the job, optionally supervising it.
+                 startup_grace: float = 300.0,
+                 restart_window_s: float = 3600.0,
+                 restart_backoff_s: float = 1.0,
+                 max_preemptions: int = 100,
+                 teardown_grace: float = 60.0) -> int:
+    """Run the job, supervising it.
 
-    ``max_restarts``: on any rank failing (or hanging, with
-    ``heartbeat_timeout``), tear down and relaunch ALL ranks — the
-    sync-SPMD recovery unit is the whole job, with progress carried by
-    checkpoints (pair the training command with ``--resume``).  The
-    reference's recovery story was manual: per-epoch checkpoints plus
-    an operator running kill.sh and re-running run.sh (SURVEY §5.3).
+    On any rank failing (or hanging, with ``heartbeat_timeout``), tear
+    down and relaunch ALL ranks — the sync-SPMD recovery unit is the
+    whole job, with progress carried by checkpoints (pair the training
+    command with ``--resume``).  The reference's recovery story was
+    manual: per-epoch checkpoints plus an operator running kill.sh and
+    re-running run.sh (SURVEY §5.3).
+
+    Exit-code classification drives the restart policy:
+
+      preempted (EXIT_PREEMPTED, 75) — the rank wrote a durable
+          emergency checkpoint before exiting: relaunch immediately,
+          WITHOUT consuming the crash budget (capped only by
+          ``max_preemptions``, a runaway-loop backstop).  Only when
+          supervision was actually requested (``max_restarts`` > 0 or a
+          ``heartbeat_timeout``): an unsupervised launch whose operator
+          SIGTERMs it must STOP, not resurrect itself 100 times.
+      crash (any other nonzero, incl. death by signal) — budgeted:
+          ``max_restarts`` crashes per sliding ``restart_window_s``
+          window (a long healthy run earns its budget back — unlike
+          the old lifetime counter, where a week of uptime and a
+          crash-loop looked the same), with exponential backoff
+          ``restart_backoff_s × 2^(n-1)`` between relaunches.
+
+    Every decision lands in ``<log_dir>/supervisor_events.jsonl``.
     """
+    os.makedirs(log_dir, exist_ok=True)
+    events = SupervisorEventLog(log_dir)
+    supervising = bool(max_restarts) or heartbeat_timeout is not None
     attempt = 0
+    preemptions = 0
+    crash_times: collections.deque = collections.deque()
     while True:
         rc = _run_once(cmd, num_processes, coordinator, log_dir,
                        devices_per_process, stagger_s, heartbeat_timeout,
-                       attempt=attempt, startup_grace=startup_grace)
-        if rc == 0 or attempt >= max_restarts:
+                       attempt=attempt, startup_grace=startup_grace,
+                       events=events, teardown_grace=teardown_grace)
+        cls = classify_exit(rc)
+        if cls == "ok":
+            events.emit("job_done", attempts=attempt)
+            return 0
+        if cls == "preempted":
+            if not supervising:
+                events.emit("give_up", code=rc, classification=cls,
+                            reason="unsupervised")
+                print("job preempted; not supervising (no --max_restarts/"
+                      "--heartbeat_timeout) — exiting", file=sys.stderr)
+                return rc
+            preemptions += 1
+            if preemptions > max_preemptions:
+                events.emit("give_up", code=rc, classification=cls,
+                            preemptions=preemptions,
+                            max_preemptions=max_preemptions)
+                print(f"giving up: {preemptions} preemptions exceed "
+                      f"--max_preemptions {max_preemptions}",
+                      file=sys.stderr)
+                return rc
+            attempt += 1
+            events.emit("restart", classification=cls, restart=attempt,
+                        backoff_s=0.0, preemptions=preemptions,
+                        crashes_in_window=len(crash_times),
+                        budget=max_restarts)
+            print(f"relaunching all {num_processes} ranks after "
+                  f"preemption (restart {attempt}; crash budget "
+                  f"untouched)", file=sys.stderr)
+            continue
+        # crash: sliding-window budget + exponential backoff
+        now = time.monotonic()
+        while crash_times and now - crash_times[0] > restart_window_s:
+            crash_times.popleft()
+        if len(crash_times) >= max_restarts:
+            events.emit("give_up", code=rc, classification=cls,
+                        crashes_in_window=len(crash_times),
+                        window_s=restart_window_s, budget=max_restarts)
             return rc
+        crash_times.append(now)
+        backoff = restart_backoff_s * (2.0 ** (len(crash_times) - 1))
         attempt += 1
-        print(f"relaunching all {num_processes} ranks (restart "
-              f"{attempt}/{max_restarts})", file=sys.stderr)
+        events.emit("restart", classification=cls, restart=attempt,
+                    backoff_s=backoff, crashes_in_window=len(crash_times),
+                    window_s=restart_window_s, budget=max_restarts)
+        print(f"relaunching all {num_processes} ranks (crash "
+              f"{len(crash_times)}/{max_restarts} in window; backoff "
+              f"{backoff:.1f}s)", file=sys.stderr)
+        if backoff > 0:
+            time.sleep(backoff)
 
 
 def cluster_commands(cmd: List[str], hosts: List[str], coordinator: str,
@@ -260,6 +405,11 @@ def main(argv=None) -> int:
     max_restarts = 0
     heartbeat_timeout: Optional[float] = None
     startup_grace: Optional[float] = None  # None → default 300 (local mode)
+    restart_window_s = 3600.0
+    restart_backoff_s = 1.0
+    max_preemptions = 100
+    teardown_grace = 60.0
+    supervise_flags_set = False
     i = 0
     while i < len(opts):
         o = opts[i]
@@ -282,6 +432,18 @@ def main(argv=None) -> int:
             heartbeat_timeout = float(opts[i + 1]); i += 2
         elif o == "--startup_grace":
             startup_grace = float(opts[i + 1]); i += 2
+        elif o == "--restart_window":
+            restart_window_s = float(opts[i + 1])
+            supervise_flags_set = True; i += 2
+        elif o == "--restart_backoff":
+            restart_backoff_s = float(opts[i + 1])
+            supervise_flags_set = True; i += 2
+        elif o == "--max_preemptions":
+            max_preemptions = int(opts[i + 1])
+            supervise_flags_set = True; i += 2
+        elif o == "--teardown_grace":
+            teardown_grace = float(opts[i + 1])
+            supervise_flags_set = True; i += 2
         else:
             raise ValueError(f"unknown launcher option {o}")
 
@@ -290,11 +452,13 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--hosts runs one rank per host; --num_processes/"
                 "--devices_per_process are not supported with it")
-        if max_restarts or heartbeat_timeout or startup_grace is not None:
+        if (max_restarts or heartbeat_timeout or startup_grace is not None
+                or supervise_flags_set):
             raise ValueError(
-                "--max_restarts/--heartbeat_timeout/--startup_grace "
-                "supervise local fan-out; for --hosts runs, supervise "
-                "on each host")
+                "--max_restarts/--heartbeat_timeout/--startup_grace/"
+                "--restart_window/--restart_backoff/--max_preemptions/"
+                "--teardown_grace supervise local fan-out; for --hosts "
+                "runs, supervise on each host")
         if coordinator == "localhost:12346":
             coordinator = f"{hosts[0]}:12346"
         lines = cluster_commands(cmd, hosts, coordinator, log_dir,
@@ -323,7 +487,11 @@ def main(argv=None) -> int:
     return launch_local(cmd, num_processes, coordinator, log_dir,
                         devices_per_process, max_restarts=max_restarts,
                         heartbeat_timeout=heartbeat_timeout,
-                        startup_grace=startup_grace)
+                        startup_grace=startup_grace,
+                        restart_window_s=restart_window_s,
+                        restart_backoff_s=restart_backoff_s,
+                        max_preemptions=max_preemptions,
+                        teardown_grace=teardown_grace)
 
 
 if __name__ == "__main__":

@@ -52,16 +52,22 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
             f"global batch_size {global_batch} must be divisible by the "
             f"process count ({jax.process_count()})")
     host_batch = global_batch // jax.process_count()
+    # The TRAIN factory accepts start_step: crash-exact resume rebuilds
+    # the stream positioned at the restored step (position-derived RNGs
+    # in the cifar/synthetic pipelines make batch n a pure function of
+    # (seed, n); imagenet re-keys best-effort — see its docstring).
     if cfg.use_synthetic_data or not cfg.data_dir:
         fns = (
-            lambda: synthetic_input_fn(spec, True, host_batch, cfg.seed),
+            lambda start_step=0: synthetic_input_fn(
+                spec, True, host_batch, cfg.seed, start_step=start_step),
             lambda: synthetic_input_fn(spec, False, host_batch, cfg.seed + 1),
         )
     elif spec.name == "cifar10":
         from dtf_tpu.data.cifar import cifar_input_fn
         fns = (
-            lambda: cifar_input_fn(cfg.data_dir, True, host_batch,
-                                   seed=cfg.seed, wire=cfg.input_wire),
+            lambda start_step=0: cifar_input_fn(
+                cfg.data_dir, True, host_batch, seed=cfg.seed,
+                wire=cfg.input_wire, start_step=start_step),
             lambda: cifar_input_fn(cfg.data_dir, False, host_batch,
                                    drop_remainder=cfg.drop_remainder,
                                    wire=cfg.input_wire),
@@ -69,12 +75,12 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
     elif spec.name == "imagenet":
         from dtf_tpu.data.imagenet import imagenet_input_fn
         fns = (
-            lambda: imagenet_input_fn(cfg.data_dir, True, host_batch,
-                                      seed=cfg.seed,
-                                      num_threads=cfg.datasets_num_private_threads,
-                                      fast_dct=cfg.input_fast_dct,
-                                      scaled_decode=cfg.input_scaled_decode,
-                                      wire=cfg.input_wire),
+            lambda start_step=0: imagenet_input_fn(
+                cfg.data_dir, True, host_batch, seed=cfg.seed,
+                num_threads=cfg.datasets_num_private_threads,
+                fast_dct=cfg.input_fast_dct,
+                scaled_decode=cfg.input_scaled_decode,
+                wire=cfg.input_wire, start_step=start_step),
             lambda: imagenet_input_fn(cfg.data_dir, False, host_batch,
                                       drop_remainder=cfg.drop_remainder,
                                       wire=cfg.input_wire),
@@ -91,8 +97,8 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
 def _channels_first_factory(fn):
     import numpy as np
 
-    def wrapped():
-        for batch in fn():
+    def wrapped(*args, **kw):
+        for batch in fn(*args, **kw):
             images = np.ascontiguousarray(
                 np.asarray(batch[0]).transpose(0, 3, 1, 2))
             yield (images,) + tuple(batch[1:])
@@ -100,11 +106,33 @@ def _channels_first_factory(fn):
 
 
 def run(cfg: Config) -> dict:
+    """Entry wrapper: arms tracing/chaos, installs the preemption
+    guard, and translates a graceful preemption (SIGTERM → emergency
+    checkpoint at the step boundary) into the distinct EXIT_PREEMPTED
+    exit code the launch.py supervisor restarts without consuming the
+    crash budget."""
+    from dtf_tpu import chaos
+    from dtf_tpu.obs import trace
+    from dtf_tpu.train import preemption
+    trace.maybe_configure(cfg)
+    chaos.maybe_configure(cfg)
+    preemption.install()
+    try:
+        return _run(cfg)
+    except preemption.Preempted as p:
+        log.warning("run preempted at step %d — emergency checkpoint "
+                    "written; exiting %d", p.step, preemption.EXIT_PREEMPTED)
+        trace.flush()
+        raise SystemExit(preemption.EXIT_PREEMPTED)
+    finally:
+        preemption.restore()
+
+
+def _run(cfg: Config) -> dict:
     # structured tracing: --trace_dir, or DTF_TRACE_DIR forwarded by the
     # launcher to every rank (idempotent when a main already configured)
     from dtf_tpu.obs import trace
     from dtf_tpu.obs.registry import default_registry
-    trace.maybe_configure(cfg)
     # metric.log exports are per-run: a second run() in the same
     # process (tests, notebooks) must not inherit the previous run's
     # process-global counters (e.g. PS wire tallies)
@@ -256,6 +284,7 @@ def run(cfg: Config) -> dict:
 
     callbacks = []
     ckpt_mod = None
+    ckpt_cb = None
     if (not cfg.skip_checkpoint or cfg.resume) and cfg.model_dir:
         try:
             from dtf_tpu.train import checkpoint as ckpt_mod
@@ -266,10 +295,21 @@ def run(cfg: Config) -> dict:
                     "the flag")
             log.warning("checkpointing disabled: orbax-checkpoint not "
                         "installed (pass --skip_checkpoint to silence)")
+    resumed_step = 0
     if ckpt_mod is not None:
         # all processes participate (orbax coordinates the collective
-        # write of the replicated state — the rank-0-write equivalent)
-        ckpt_cb = ckpt_mod.CheckpointCallback(cfg.model_dir)
+        # write of the replicated state — the rank-0-write equivalent).
+        # The manifest carries the host half of crash-exact resume:
+        # data position + the seed that derives the pipeline RNGs.
+        spe = max(trainer.steps_per_epoch, 1)
+        host_state_fn = lambda step: {
+            "seed": cfg.seed, "global_step": step,
+            "epoch": step // spe, "step_in_epoch": step % spe,
+            "data": {"scheme": "position-derived", "dataset": cfg.dataset,
+                     "start_step": step}}
+        ckpt_cb = ckpt_mod.CheckpointCallback(
+            cfg.model_dir, every_steps=cfg.checkpoint_steps,
+            host_state_fn=host_state_fn)
         if cfg.resume:
             # restore with the state's own per-leaf shardings (TP/EP/PP
             # states are not replicated — a blanket replicated sharding
@@ -279,6 +319,19 @@ def run(cfg: Config) -> dict:
             restored = ckpt_cb.ckpt.restore(state, sharding=state_shardings)
             if restored is not None:
                 state = restored
+                resumed_step = int(jax.device_get(state.step))
+                host = ckpt_cb.ckpt.host_state(
+                    ckpt_cb.ckpt.last_restored_step)
+                if host and host.get("seed") is not None \
+                        and host["seed"] != cfg.seed:
+                    # a different seed re-derives a DIFFERENT data
+                    # stream: the resumed run would silently train on
+                    # other batches than the run it claims to continue
+                    raise ValueError(
+                        f"--resume seed mismatch: checkpoint was written "
+                        f"with seed {host['seed']}, this run has "
+                        f"--seed {cfg.seed}; crash-exact resume needs the "
+                        f"same seed (pass --seed {host['seed']})")
             elif cfg.eval_only:
                 # evaluating random init as if it were a checkpoint would
                 # silently report garbage — fail instead
@@ -305,23 +358,44 @@ def run(cfg: Config) -> dict:
         log.info("Run stats (eval only): %s", stats)
         return stats
 
-    prefetched = DevicePrefetcher(itertools.chain([first], train_iter), rt,
-                                  buffer_size=2)
+    if resumed_step > 0:
+        # crash-exact resume: rebuild the stream POSITIONED at the
+        # restored step (the probe iterator above consumed batch 0 of a
+        # step-0 stream — close it so its worker threads/buffers don't
+        # idle alongside the real pipeline for the whole run; the loop
+        # starts at batch resumed_step and must see exactly that batch)
+        if hasattr(train_iter, "close"):
+            train_iter.close()
+        first = None
+        prefetched = DevicePrefetcher(train_fn(start_step=resumed_step),
+                                      rt, buffer_size=2)
+    else:
+        prefetched = DevicePrefetcher(itertools.chain([first], train_iter),
+                                      rt, buffer_size=2)
 
     # logger.benchmark_context parity (resnet_cifar_main.py:234)
     from dtf_tpu.utils.benchmark_logger import benchmark_context
-    with benchmark_context(cfg) as bench_log:
-        state, stats = trainer.fit(
-            state, prefetched,
-            eval_iter_fn=None if cfg.skip_eval else eval_fn,
-            callbacks=callbacks)
-        if bench_log is not None:
-            step_now = int(jax.device_get(state.step))
-            bench_log.log_stats(stats, global_step=step_now)
-            # process-global obs registry (PS wire counters etc.) rides
-            # the same metric.log; empty registries write nothing
-            bench_log.log_registry(default_registry(),
-                                   global_step=step_now)
+    try:
+        with benchmark_context(cfg) as bench_log:
+            state, stats = trainer.fit(
+                state, prefetched,
+                eval_iter_fn=None if cfg.skip_eval else eval_fn,
+                callbacks=callbacks)
+            if bench_log is not None:
+                step_now = int(jax.device_get(state.step))
+                bench_log.log_stats(stats, global_step=step_now)
+                # process-global obs registry (PS wire counters etc.)
+                # rides the same metric.log; empty registries write
+                # nothing
+                bench_log.log_registry(default_registry(),
+                                       global_step=step_now)
+    finally:
+        # EVERY exit — normal, watchdog TrainingAnomaly abort,
+        # preemption — lands the in-flight async orbax save and seals
+        # its manifest; an orphaned write is exactly the truncated
+        # checkpoint the integrity fallback exists to catch
+        if ckpt_cb is not None:
+            ckpt_cb.ckpt.close()
 
     if export_model is not None:
         # --export_dir parity: final inference variables, written once

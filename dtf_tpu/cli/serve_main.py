@@ -20,6 +20,8 @@ Examples:
 from __future__ import annotations
 
 import logging
+import os
+import signal
 import sys
 import time
 
@@ -83,22 +85,55 @@ def serve(cfg, random_init: bool = False) -> dict:
         # contradiction check
         prefill_chunk=cfg.serve_prefill_chunk)
 
-    # synthetic traffic: varied-length prompts, all submitted up front
-    # (a burst — the shape that exercises batching + the queue)
+    # serve drain: SIGTERM (the preemption signal) stops admissions —
+    # new submits shed with retry_after — finishes in-flight decodes,
+    # and the process exits 0 (a drained replica is a clean exit the
+    # supervisor does not classify as a crash).  The handler body is
+    # async-signal-minimal: one lock-free engine call + one os.write.
+    drained = {"signaled": False}
+
+    def _on_sigterm(signum, frame):
+        drained["signaled"] = True
+        engine.begin_drain()
+        os.write(2, b"serve: SIGTERM - draining (admissions shed, "
+                    b"in-flight finishing)\n")
+
+    old_handler = None
+    try:
+        old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread (library/test use)
+        pass
+
+    from dtf_tpu.serve.engine import Backpressure
     rng = np.random.default_rng(cfg.seed)
     vocab = model.vocab_size
     handles = []
+    shed_by_drain = 0
     t0 = time.time()
-    for _ in range(cfg.serve_requests):
-        plen = int(rng.integers(1, cfg.serve_prompt_len + 1))
-        prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
-        handles.append(engine.submit(
-            prompt, max_new_tokens=cfg.serve_max_new_tokens,
-            temperature=cfg.serve_temperature))
-    for h in handles:
-        h.result(timeout=600)
-    wall = time.time() - t0
-    engine.stop()
+    try:
+        # synthetic traffic: varied-length prompts, all submitted up
+        # front (a burst — the shape that exercises batching + queue)
+        for _ in range(cfg.serve_requests):
+            plen = int(rng.integers(1, cfg.serve_prompt_len + 1))
+            prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
+            try:
+                handles.append(engine.submit(
+                    prompt, max_new_tokens=cfg.serve_max_new_tokens,
+                    temperature=cfg.serve_temperature))
+            except Backpressure:
+                # drain (or a genuinely full queue): the request is the
+                # client's to retry elsewhere
+                shed_by_drain += 1
+        for h in handles:
+            h.result(timeout=600)
+        wall = time.time() - t0
+        engine.stop()  # drain=True: waits out queued + in-flight work
+    finally:
+        if old_handler is not None:
+            signal.signal(signal.SIGTERM, old_handler)
+    if drained["signaled"]:
+        log.info("serve: drained after SIGTERM (%d in-flight finished, "
+                 "%d shed) — exiting 0", len(handles), shed_by_drain)
 
     stats = collect_stats(engine.completed, engine.shed_count,
                           wall_time_s=wall)

@@ -7,13 +7,20 @@ max), event counts, and every anomaly record.
 
 Usage:
   python -m dtf_tpu.cli.trace_main <trace_dir | trace.jsonl> [...]
-      [--check] [--json]
+      [--check] [--allow <kind>]... [--json]
 
 ``--check`` is the CI/bench contract: exit 0 only when the trace
 contains NO anomaly records (nan_loss, step_time_regression, ...), so a
-bench script can assert a run was clean with one command.  ``--json``
-emits the summary as one JSON object instead of the table (machine
-consumers).
+bench script can assert a run was clean with one command.
+
+``--allow <kind>`` (repeatable) declares EXPECTED anomalies: a chaos
+run asserts "the injected fault fired and nothing else broke" with
+``--check --allow injected_fault``.  Allowed kinds are still printed
+(flagged ALLOWED) but no longer fail the check; every anomaly of any
+other kind still does.
+
+``--json`` emits the summary as one JSON object instead of the table
+(machine consumers).
 """
 
 from __future__ import annotations
@@ -86,7 +93,8 @@ def summarize(files: List[str]) -> dict:
     }
 
 
-def print_summary(summary: dict) -> None:
+def print_summary(summary: dict, allowed=()) -> None:
+    allowed = set(allowed)
     print(f"trace files: {len(summary['files'])}  "
           f"ranks: {summary['ranks']}  "
           f"step spans: {summary['step_spans']}")
@@ -105,7 +113,9 @@ def print_summary(summary: dict) -> None:
     for a in summary["anomalies"]:
         detail = {k: v for k, v in a.items()
                   if k not in ("kind", "name", "ts")}
-        print(f"ANOMALY: {a.get('name', '?')} {detail}")
+        tag = ("ALLOWED ANOMALY" if a.get("name") in allowed
+               else "ANOMALY")
+        print(f"{tag}: {a.get('name', '?')} {detail}")
     if not summary["anomalies"]:
         print("anomalies: none")
 
@@ -118,20 +128,30 @@ def main(argv=None) -> int:
                     help="trace dir(s) or trace_rank*.jsonl file(s)")
     ap.add_argument("--check", action="store_true",
                     help="exit nonzero when any anomaly record is present")
+    ap.add_argument("--allow", action="append", default=[], metavar="KIND",
+                    help="anomaly kind --check tolerates (repeatable): "
+                         "chaos runs pass --allow injected_fault to "
+                         "assert 'only the injected fault'")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
 
     files = discover(args.paths)
     summary = summarize(files)
+    allowed = set(args.allow)
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
     else:
-        print_summary(summary)
-    if args.check and summary["anomalies"]:
-        print(f"--check: {len(summary['anomalies'])} anomaly record(s) — "
-              f"run was NOT clean", file=sys.stderr)
-        return 1
+        print_summary(summary, allowed=allowed)
+    if args.check:
+        blocked = [a for a in summary["anomalies"]
+                   if a.get("name") not in allowed]
+        if blocked:
+            tolerated = len(summary["anomalies"]) - len(blocked)
+            print(f"--check: {len(blocked)} anomaly record(s)"
+                  + (f" ({tolerated} allowed)" if tolerated else "")
+                  + " — run was NOT clean", file=sys.stderr)
+            return 1
     return 0
 
 

@@ -111,6 +111,12 @@ class Config:
     log_steps: int = 100                # --log_steps for BenchmarkMetric cadence
     skip_checkpoint: bool = False       # rank-0 checkpoints off (horovod mains default on)
     resume: bool = False                # restore latest checkpoint from model_dir
+    # preemption-granularity checkpointing: additionally save (sync,
+    # sealed with an integrity manifest) every N global steps.  0 = the
+    # reference's per-epoch-only cadence.  On preemptible pods the
+    # epoch is far too coarse a recovery unit — a rank lost mid-epoch
+    # re-trains the whole epoch
+    checkpoint_steps: int = 0
 
     # --- benchmark (define_benchmark) ---
     benchmark_log_dir: str = ""         # --benchmark_log_dir
@@ -250,6 +256,14 @@ class Config:
     # is only written when the launcher exports DTF_HEARTBEAT_DIR
     heartbeat_secs: float = 5.0
 
+    # --- chaos (dtf_tpu/chaos: deterministic fault injection) ---
+    # comma-separated fault specs, e.g. "crash@step:120",
+    # "sigterm@rank1:step:80", "ps_drop@version:50",
+    # "heartbeat_stall@step:60", "ckpt_truncate@latest".  "" = off (the
+    # DTF_FAULT env var also arms it).  Provably zero-cost when unset:
+    # every probe is a module-level None check (tests/test_chaos.py)
+    fault: str = ""
+
     # --- misc ---
     seed: int = 0
     verbose: int = 2                    # keras fit verbose parity (rank-gated)
@@ -341,6 +355,15 @@ class Config:
         if self.heartbeat_secs <= 0:
             raise ValueError(
                 f"heartbeat_secs must be positive, got {self.heartbeat_secs}")
+        if self.checkpoint_steps < 0:
+            raise ValueError(
+                f"checkpoint_steps must be >= 0 (0 = per-epoch only), "
+                f"got {self.checkpoint_steps}")
+        if self.fault:
+            # fail at flag-parse time, not at the step the typo'd fault
+            # silently never fires
+            from dtf_tpu import chaos
+            chaos.parse_spec(self.fault)
         if self.eval_only and not self.resume:
             raise ValueError(
                 "--eval_only evaluates a restored checkpoint; pass "

@@ -116,8 +116,17 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
                    seed: int = 0, process_id: Optional[int] = None,
                    process_count: Optional[int] = None,
                    drop_remainder: bool = True,
-                   wire: str = "float32") -> Iterator:
+                   wire: str = "float32", start_step: int = 0) -> Iterator:
     """Yields (images, labels) numpy batches; infinite for training.
+
+    POSITION-DERIVED randomness (crash-exact resume): the shuffle order
+    of epoch *e* and the augmentation draws of batch *(e, k)* are each
+    seeded from ``(seed, process_id, e[, k])`` counters, never from a
+    long-lived RNG stream.  Batch *n* of the training stream is
+    therefore a pure function of (seed, process, n) — a run restored
+    from a checkpoint at step *n* passes ``start_step=n`` and sees the
+    EXACT batch sequence the uninterrupted run would have seen, without
+    replaying (or skipping) a single example.
 
     ``wire``: host→device batch format.  ``"float32"`` standardizes on
     the host (per_image_standardization, the r1-r3 behavior);
@@ -160,7 +169,8 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
             f"process {process_id}'s file shard holds {len(images)} images, "
             f"fewer than the per-host batch {batch_size}; reduce batch_size "
             f"or process count")
-    rng = np.random.default_rng(seed + 7919 * process_id)
+    # nonnegative per-process base entropy for the counter-derived RNGs
+    seed_base = (int(seed) + 7919 * int(process_id)) & 0xFFFFFFFF
 
     def finalize(batch: np.ndarray) -> np.ndarray:
         if u8:
@@ -169,12 +179,23 @@ def cifar_input_fn(data_dir: str, is_training: bool, batch_size: int,
 
     def gen():
         if is_training:
+            per_epoch = len(images) // batch_size
+            step = int(start_step)
+            cur_epoch, order = -1, None
             while True:
-                order = rng.permutation(len(images))
-                for i in range(0, len(order) - batch_size + 1, batch_size):
-                    idx = order[i:i + batch_size]
-                    batch = augment_batch(images[idx], rng)
-                    yield finalize(batch), labels[idx]
+                epoch, k = divmod(step, per_epoch)
+                if epoch != cur_epoch:
+                    # full-dataset shuffle, derived from (seed, epoch)
+                    # alone — any step of any epoch is reconstructable
+                    cur_epoch = epoch
+                    order = np.random.default_rng(
+                        np.random.SeedSequence(
+                            [seed_base, epoch])).permutation(len(images))
+                idx = order[k * batch_size:(k + 1) * batch_size]
+                brng = np.random.default_rng(
+                    np.random.SeedSequence([seed_base, epoch, k, 1]))
+                yield finalize(augment_batch(images[idx], brng)), labels[idx]
+                step += 1
         elif drop_remainder:
             for i in range(0, len(images) - batch_size + 1, batch_size):
                 yield (finalize(images[i:i + batch_size].copy()),

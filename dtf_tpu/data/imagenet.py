@@ -259,7 +259,7 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
                       fast_dct: bool = False,
                       scaled_decode: bool = False,
                       stats: Optional[dict] = None,
-                      wire: str = "float32") -> Iterator:
+                      wire: str = "float32", start_step: int = 0) -> Iterator:
     """Yields (images [B,224,224,3], labels int32 [B]) — plus a
     float32 validity mask [B] for eval with ``drop_remainder=False``.
 
@@ -290,6 +290,22 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     process_id = jax.process_index() if process_id is None else process_id
     process_count = (jax.process_count() if process_count is None
                      else process_count)
+    if is_training and start_step:
+        # Resume positioning, BEST-EFFORT: this pipeline's batch
+        # composition depends on decode-worker timing (the shuffle
+        # buffer drains nondeterministically across threads), so a
+        # bit-exact replay from step N is not defined.  What IS
+        # guaranteed: re-keying the stream by the resumed position
+        # gives a restarted run a fresh shuffle, so it neither replays
+        # the epoch prefix it already trained on nor repeats the exact
+        # crashed-run order — the "silently trains on repeated batches"
+        # failure mode is closed even where exactness can't be.
+        # (cifar/synthetic pipelines are position-derived and exact.)
+        import logging
+        logging.getLogger("dtf_tpu").warning(
+            "imagenet resume at step %d: threaded pipeline is re-keyed "
+            "(fresh shuffle), not bit-exact-replayed", start_step)
+        seed = int(seed) + 1_000_003 * int(start_step)
     if wire not in ("float32", "uint8"):
         raise ValueError(f"wire must be 'float32' or 'uint8', got {wire!r}")
     u8 = wire == "uint8"

@@ -26,10 +26,17 @@ def _truncated_normal(rng, shape, mean, std):
 
 
 def synthetic_input_fn(spec: DatasetSpec, is_training: bool, batch_size: int,
-                       seed: int = 0, dtype=np.float32):
+                       seed: int = 0, dtype=np.float32,
+                       start_step: int = 0):
     """Yields the same (images, labels) batch forever (train) or for one
     eval pass.  labels are int32 class ids; one-hot is applied by the
-    loss layer when spec.one_hot."""
+    loss layer when spec.one_hot.
+
+    ``start_step`` exists for pipeline-position parity with the real
+    input fns (crash-exact resume repositions its data stream here):
+    the synthetic stream repeats one batch, so every position is
+    identical and the argument is accepted but has no effect."""
+    del start_step  # position-independent by construction
     rng = np.random.default_rng(seed)
     if spec.is_sequence:
         # token LM: random ids, next-token labels (shift left; the final

@@ -155,6 +155,14 @@ class Heartbeat:
         now = time.monotonic()
         if not force and now - self._last < self.interval_s:
             return False
+        # chaos heartbeat_stall@step:N: silently stop writing from step
+        # N on — the deadlocked-but-alive signature the supervisor's
+        # heartbeat watchdog must catch.  Lazy import (obs/__init__
+        # imports this module; chaos imports obs.trace — importing
+        # chaos at module top would cycle through the package init).
+        from dtf_tpu import chaos
+        if chaos.heartbeat_stalled(step):
+            return False
         self._last = now
         payload = {"ts": time.time(), "step": step, "pid": os.getpid()}
         tmp = f"{self.path}.tmp.{os.getpid()}"

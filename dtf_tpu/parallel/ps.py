@@ -34,6 +34,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dtf_tpu import chaos
 from dtf_tpu import native as native_lib
 from dtf_tpu.obs import trace
 from dtf_tpu.obs.registry import default_registry
@@ -553,6 +554,17 @@ class PsClient:
         self._m_push_bytes = reg.counter("ps_client_push_bytes", unit="bytes")
         self._connect(connect_timeout)
 
+    def _chaos_drop(self) -> None:
+        """ps_drop@version:N probe: once the observed store version
+        reaches N, sever this client's connection (one-shot) — the next
+        op fails with OSError and exercises the real reconnect+backoff
+        machinery, not a mock of it."""
+        if chaos.ps_drop(self._last_version):
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
     def _connect(self, timeout: float):
         deadline = time.time() + timeout
         delay = 0.2
@@ -705,6 +717,7 @@ class PsClient:
                 self._last_version = max(self._last_version, ver)
                 self._m_pulls.inc()
                 self._m_pull_bytes.inc((2 if bf16 else 4) * int(n))
+                self._chaos_drop()
                 return ver, flat
             return None
 
@@ -739,6 +752,7 @@ class PsClient:
             self._last_version = max(self._last_version, ver)
             self._m_pushes.inc()
             self._m_push_bytes.inc(len(msg))
+            self._chaos_drop()
             return ver
 
         with trace.span("ps_push", bf16=bf16):
@@ -927,15 +941,31 @@ def run_async(cfg) -> dict:
                          "coordinator)")
     num_workers = n_procs - 1
     if cfg.process_id == 0:
+        from dtf_tpu.train import preemption
         port = int(cfg.coordinator_address.rpartition(":")[2])
         server, snap = _serve_with_snapshots(cfg, port=port)
         log.info("PS rank: serving %d workers", num_workers)
         try:
-            server.wait(num_workers)  # blocks like the reference PS rank,
-        finally:                      # but exits when all workers finish
+            # blocks like the reference PS rank, but exits when all
+            # workers finish — AND polls for preemption: preempted
+            # workers deliberately skip their DONE (progress lives in
+            # the store snapshot), so wait(num_workers) would never
+            # return on a pod-wide SIGTERM; the PS rank must notice its
+            # own latched signal, dump a final snapshot, and exit 75
+            # with everyone else instead of hanging until SIGKILL
+            # (which would classify as a crash and burn restart budget)
+            waiter = threading.Thread(target=server.wait,
+                                      args=(num_workers,), daemon=True)
+            waiter.start()
+            while waiter.is_alive():
+                waiter.join(timeout=0.5)
+                signum = preemption.triggered()
+                if signum is not None:
+                    raise preemption.Preempted(0, signum)
+        finally:
             if snap:
-                snap.stop()
-            server.stop()
+                snap.stop()  # final dump: a clean OR preempted stop
+            server.stop()    # loses nothing
         return {}
     return _worker(cfg, cfg.coordinator_address,
                    worker_id=cfg.process_id - 1, num_workers=num_workers)
@@ -1100,11 +1130,13 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
     # values this loop already syncs, heartbeat when launched under the
     # supervisor (a PS worker that deadlocks in pull() stops beating)
     from dtf_tpu.obs.watchdog import Heartbeat, NanLossWatchdog
+    from dtf_tpu.train import preemption
     nan_guard = NanLossWatchdog(enabled=getattr(cfg, "nan_guard", True))
     heartbeat = Heartbeat.from_env(
         interval_s=getattr(cfg, "heartbeat_secs", 5.0))
     time_cb.on_train_begin()
     local_step = 0
+    preempted = False
     # the whole worker body runs under a DONE guarantee: a NaN-guard
     # abort (or any other worker death past init) must still deliver
     # this worker's DONE, or the PS rank's wait(num_workers) hangs one
@@ -1135,6 +1167,15 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
                 time_cb.on_batch_end(local_step)
                 if heartbeat is not None:
                     heartbeat.beat(step=local_step)
+                # chaos step probe (crash@step / sigterm@step fire here
+                # for PS workers too) + cooperative preemption: the
+                # store already holds every pushed gradient, so a
+                # preempted worker just exits EXIT_PREEMPTED — progress
+                # lives in the PS snapshot, not a local checkpoint
+                chaos.step(local_step)
+                signum = preemption.triggered()
+                if signum is not None:
+                    raise preemption.Preempted(local_step, signum)
             m_loss, m_acc = (float(jax.device_get(loss)),
                              float(jax.device_get(acc)))
             nan_guard.check(local_step, m_loss)
@@ -1177,6 +1218,13 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
                 # PS wire counters (pulls/pushes/bytes/reconnects) ride
                 # the same metric.log the training stats land in
                 blog.log_registry(default_registry(), global_step=local_step)
+    except preemption.Preempted:
+        # preempted, NOT finished: the supervisor restarts the whole
+        # job, and this worker will run again — delivering DONE now
+        # would poison the (snapshot-persisted) done_count and let the
+        # restarted PS rank's wait(num_workers) return early
+        preempted = True
+        raise
     except BaseException:
         # dying worker: still deliver DONE (the finally below), but
         # best-effort FAST — done()'s retried INFO probe must not burn
@@ -1186,7 +1234,8 @@ def _worker(cfg, ps_address: str, worker_id: int, num_workers: int) -> dict:
         raise
     finally:
         try:
-            client.done()  # swallows delivery failures (logs a warning)
+            if not preempted:
+                client.done()  # swallows delivery failures (logs warning)
         finally:
             client.close()
     log.info("Run stats: %s",

@@ -254,6 +254,7 @@ class ServeEngine:
         self._pending: List[_Handle] = []
         self._slots: List[Optional[_Slot]] = [None] * self.max_batch
         self._stop = threading.Event()
+        self._draining = False
         self._ids = itertools.count()
         # metrics: the raw result list stays (collect_stats consumes
         # it); live operational state goes through the obs registry —
@@ -362,6 +363,19 @@ class ServeEngine:
             # stopped engine, where nothing would ever deliver it
             if self._stop.is_set():
                 raise RuntimeError("engine is stopped")
+            if self._draining:
+                # SIGTERM drain: admissions stop the moment the signal
+                # lands; already-queued + in-flight work still finishes.
+                # Shed, not error — the client retries against another
+                # replica after retry_after, exactly like a full queue
+                self._m_shed.inc()
+                retry = max(0.05, self._ewma_latency)
+                log.warning("serve: draining — shedding request "
+                            "(retry_after=%.2fs)", retry)
+                trace.anomaly("serve_shed", reason="draining",
+                              shed_total=self.shed_count,
+                              retry_after=retry)
+                raise Backpressure(retry)
             if len(self._pending) >= self.queue_size:
                 self._m_shed.inc()
                 retry = max(0.05, self._ewma_latency
@@ -635,6 +649,26 @@ class ServeEngine:
             self._cond.notify_all()
 
     # -- lifecycle -----------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Graceful-shutdown phase 1 (called from the SIGTERM handler,
+        so it must be async-signal-tolerant: no blocking lock, no
+        logging — the interrupted frame may already hold either lock).
+        New submits shed with ``retry_after``; queued and in-flight
+        requests keep decoding to completion.  Follow with
+        ``stop(drain=True)`` to wait them out and join the engine
+        thread — then exit 0: a drained process is a CLEAN exit, not a
+        casualty."""
+        self._draining = True  # atomic under the GIL; read under _cond
+        if self._cond.acquire(blocking=False):  # best-effort wake
+            try:
+                self._cond.notify_all()
+            finally:
+                self._cond.release()
+
     def stop(self, drain: bool = True, timeout: float = 60.0):
         """Stop the engine.  ``drain=True`` finishes in-flight AND
         already-queued work first; False cancels queued requests."""

@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume with integrity verification.
 
 Reference behavior to match (SURVEY §5.4): the Horovod mains attach a
 rank-0-only per-epoch `ModelCheckpoint('./checkpoint-{epoch}.h5')`
@@ -13,24 +13,160 @@ save/restore collectively (orbax coordinates the write; with fully
 replicated state the writing is effectively coordinator-led, matching
 the rank-0 semantics), and the restored arrays are device_put back with
 the replicated sharding — the broadcast-equivalent.
+
+Crash-hardening on top (this is what makes `--resume` trustworthy on a
+preemptible pod):
+
+  integrity manifests — every completed save is sealed with a digest
+      manifest (``<model_dir>/checkpoints.meta/manifest_<step>.json``:
+      per-file size + sha256, written atomically AFTER orbax finishes).
+      Restore verifies the newest step against its manifest and FALLS
+      BACK to the newest *verified* step on corruption or truncation,
+      emitting a structured ``ckpt_integrity`` anomaly instead of
+      crashing — a half-written checkpoint (the process died mid-save)
+      degrades a restart by one checkpoint interval, not to scratch.
+  host-side state  — the manifest carries the host training position
+      (global step, epoch, step-in-epoch, data-pipeline scheme + seed)
+      so a resumed run can reposition its data stream exactly; with the
+      position-derived pipeline RNGs (data/cifar.py) that makes the
+      resumed batch sequence bit-identical to the uninterrupted run.
+  synchronous seals — interval/preemption saves pass ``sync=True``:
+      save + wait + manifest before the caller proceeds, so the
+      supervisor can restart the rank the moment it exits knowing the
+      newest checkpoint is durable and verified.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
+from dtf_tpu import chaos
 from dtf_tpu.obs import trace
 
 log = logging.getLogger("dtf_tpu")
 
 
+# ---------------------------------------------------------------------------
+# Integrity manifests (module-level: the serve bridge's structure-free
+# loader shares them with the Checkpointer)
+# ---------------------------------------------------------------------------
+
+def meta_dir(ckpt_directory: str) -> str:
+    """Manifest directory for a checkpoints root.  A SIBLING of the
+    orbax root, never inside it — orbax owns its directory's layout and
+    step scanning."""
+    return ckpt_directory.rstrip("/") + ".meta"
+
+
+def manifest_path(ckpt_directory: str, step: int) -> str:
+    return os.path.join(meta_dir(ckpt_directory), f"manifest_{int(step)}.json")
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_manifest(ckpt_directory: str, step: int,
+                   host_state: Optional[dict] = None) -> str:
+    """Seal a COMPLETED step directory: digest every file, write the
+    manifest atomically (tmp + rename).  Must only be called after the
+    orbax save finished (Checkpointer.wait does this ordering)."""
+    step_dir = os.path.join(ckpt_directory, str(int(step)))
+    files = {}
+    for root, _, names in os.walk(step_dir):
+        for name in sorted(names):
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, step_dir)
+            files[rel] = {"size": os.path.getsize(full),
+                          "sha256": _sha256(full)}
+    payload = {"step": int(step), "files": files,
+               "host_state": dict(host_state or {})}
+    path = manifest_path(ckpt_directory, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(ckpt_directory: str, step: int) -> Optional[dict]:
+    """The manifest dict, or None when missing/unreadable (a torn or
+    corrupt manifest reads as 'unverified', not as 'corrupt payload' —
+    the payload may be fine and restore is still attempted)."""
+    try:
+        with open(manifest_path(ckpt_directory, step)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def verify_step(ckpt_directory: str, step: int) -> str:
+    """Integrity verdict for one step: ``"ok"`` (manifest present, every
+    file matches), ``"corrupt"`` (manifest present, a file is missing /
+    resized / digest-mismatched — truncation and bit-rot both land
+    here), or ``"unverified"`` (no readable manifest: a legacy
+    checkpoint, or the process died between the save and the seal)."""
+    manifest = read_manifest(ckpt_directory, step)
+    if manifest is None:
+        return "unverified"
+    step_dir = os.path.join(ckpt_directory, str(int(step)))
+    for rel, info in manifest.get("files", {}).items():
+        full = os.path.join(step_dir, rel)
+        try:
+            if os.path.getsize(full) != info["size"]:
+                return "corrupt"
+            if _sha256(full) != info["sha256"]:
+                return "corrupt"
+        except OSError:
+            return "corrupt"
+    return "ok"
+
+
+def _chaos_truncate_newest(ckpt_directory: str) -> None:
+    """ckpt_truncate@latest fault action: halve the largest payload file
+    of the newest step directory — the torn-write a preempted save
+    leaves behind, minus the nondeterminism."""
+    try:
+        steps = sorted(int(d) for d in os.listdir(ckpt_directory)
+                       if d.isdigit())
+    except OSError:
+        return
+    if not steps:
+        return
+    step_dir = os.path.join(ckpt_directory, str(steps[-1]))
+    largest: Tuple[int, Optional[str]] = (0, None)
+    for root, _, names in os.walk(step_dir):
+        for name in names:
+            full = os.path.join(root, name)
+            size = os.path.getsize(full)
+            if size > largest[0]:
+                largest = (size, full)
+    if largest[1] is None:
+        return
+    size, victim = largest
+    with open(victim, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    log.error("chaos: truncated %s (%d -> %d bytes) in checkpoint step "
+              "%d", victim, size, max(size // 2, 1), steps[-1])
+
+
 class Checkpointer:
-    """TrainState save/restore under <model_dir>/checkpoints."""
+    """TrainState save/restore under <model_dir>/checkpoints, with
+    digest manifests under <model_dir>/checkpoints.meta."""
 
     def __init__(self, model_dir: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(os.path.join(model_dir, "checkpoints"))
@@ -38,38 +174,148 @@ class Checkpointer:
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+        # steps saved but not yet sealed with a manifest (wait() seals)
+        self._pending: List[Tuple[int, Optional[dict]]] = []
+        # which step the last restore() actually used (fallbacks move it
+        # below latest_step; callers reposition their data stream on it)
+        self.last_restored_step: Optional[int] = None
 
-    def save(self, state, step: Optional[int] = None) -> None:
+    def save(self, state, step: Optional[int] = None,
+             host_state: Optional[dict] = None, sync: bool = False) -> None:
+        """Save; ``host_state`` rides the integrity manifest (data
+        position / seed — the host half of crash-exact resume).
+        ``sync=True`` waits for the write AND seals the manifest before
+        returning — the durability interval/preemption saves need."""
         step = int(state.step) if step is None else int(step)
-        with trace.span("checkpoint_save", step=step):
+        with trace.span("checkpoint_save", step=step, sync=sync):
             self._mgr.save(step, args=ocp.args.StandardSave(state))
-        log.info("checkpoint saved: step %d -> %s", step, self.directory)
+            self._pending.append((step, host_state))
+            if sync:
+                self.wait()
+        log.info("checkpoint saved: step %d -> %s%s", step, self.directory,
+                 " (sealed)" if sync else "")
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> List[int]:
+        return sorted(int(s) for s in self._mgr.all_steps())
+
+    def verify(self, step: int) -> str:
+        return verify_step(self.directory, step)
+
+    def verified_steps(self) -> List[int]:
+        return [s for s in self.all_steps() if self.verify(s) == "ok"]
+
+    def host_state(self, step: int) -> Optional[dict]:
+        m = read_manifest(self.directory, step)
+        return None if m is None else m.get("host_state") or None
 
     def restore(self, abstract_state, step: Optional[int] = None,
                 sharding=None):
         """Restores into the structure of `abstract_state` (a concrete or
         ShapeDtypeStruct TrainState); placed with `sharding` if given —
-        restore-then-rebroadcast semantics."""
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
+        restore-then-rebroadcast semantics.
+
+        With ``step=None`` (the resume path) candidates are tried newest
+        first; a step whose manifest verification fails, or whose orbax
+        restore raises (truncated / mid-write directory), is skipped
+        with a structured ``ckpt_integrity`` anomaly and the next older
+        step is tried — restart survives a torn newest checkpoint by
+        losing one interval, not the run.  An explicit ``step`` is
+        restored as asked (verification failure raises)."""
+        if chaos.ckpt_truncate():
+            _chaos_truncate_newest(self.directory)
+        explicit = step is not None
+        candidates = [int(step)] if explicit else list(
+            reversed(self.all_steps()))
+        if not candidates:
             return None
-        with trace.span("checkpoint_restore", step=int(step)):
-            abstract = jax.tree_util.tree_map(
-                ocp.utils.to_shape_dtype_struct, abstract_state)
-            restored = self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract))
-            if sharding is not None:
-                restored = jax.device_put(restored, sharding)
-        log.info("checkpoint restored: step %d from %s", step, self.directory)
-        return restored
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, abstract_state)
+        newest = candidates[0]
+        for s in candidates:
+            verdict = self.verify(s)
+            if verdict == "corrupt":
+                trace.anomaly("ckpt_integrity", step=s, verdict=verdict,
+                              action="raise" if explicit else "fallback")
+                log.error("checkpoint step %d FAILED integrity "
+                          "verification (%s)", s, verdict)
+                if explicit:
+                    raise OSError(
+                        f"checkpoint step {s} under {self.directory} failed "
+                        f"integrity verification")
+                continue
+            try:
+                with trace.span("checkpoint_restore", step=s,
+                                verified=(verdict == "ok")):
+                    restored = self._mgr.restore(
+                        s, args=ocp.args.StandardRestore(abstract))
+                    if sharding is not None:
+                        restored = jax.device_put(restored, sharding)
+            except Exception as e:  # noqa: BLE001 — orbax raises many types
+                if explicit:
+                    raise
+                trace.anomaly("ckpt_integrity", step=s, verdict="unreadable",
+                              error=type(e).__name__, action="fallback")
+                log.error("checkpoint step %d unreadable (%s: %s) — "
+                          "falling back", s, type(e).__name__, e)
+                continue
+            if s != newest:
+                log.warning("checkpoint restore FELL BACK: step %d "
+                            "(newest %d failed verification/restore) — "
+                            "one checkpoint interval of work re-trains",
+                            s, newest)
+            self.last_restored_step = s
+            log.info("checkpoint restored: step %d from %s (%s)", s,
+                     self.directory, verdict)
+            return restored
+        trace.anomaly("ckpt_integrity", step=newest, verdict="none_usable",
+                      action="from_scratch")
+        log.error("NO checkpoint under %s survived verification — "
+                  "resume falls back to training from scratch",
+                  self.directory)
+        return None
 
     def wait(self) -> None:
+        """Block until in-flight saves land, then seal them with
+        manifests (and drop manifests orphaned by max_to_keep pruning).
+        EVERY exit path must reach this (or close()) — an abort that
+        orphans an async orbax write is exactly the truncation the
+        integrity check exists to catch."""
         self._mgr.wait_until_finished()
+        pending, self._pending = self._pending, []
+        for step, host_state in pending:
+            step_dir = os.path.join(self.directory, str(step))
+            if os.path.isdir(step_dir):  # may have been pruned already
+                write_manifest(self.directory, step, host_state)
+        self._prune_manifests()
+
+    def _prune_manifests(self) -> None:
+        live = {int(s) for s in self._mgr.all_steps()}
+        mdir = meta_dir(self.directory)
+        try:
+            names = os.listdir(mdir)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("manifest_") and name.endswith(".json")):
+                continue
+            try:
+                step = int(name[len("manifest_"):-len(".json")])
+            except ValueError:
+                continue
+            if step not in live:
+                try:
+                    os.unlink(os.path.join(mdir, name))
+                except OSError:
+                    pass
 
     def close(self) -> None:
+        try:
+            self.wait()
+        except Exception:  # noqa: BLE001 — closing must not mask the abort
+            log.exception("checkpointer: wait() failed during close")
         self._mgr.close()
 
 
@@ -82,9 +328,12 @@ def export_model(export_dir: str, state) -> str:
     ckptr = ocp.StandardCheckpointer()
     payload = {"params": state.params, "batch_stats": state.batch_stats}
     with trace.span("checkpoint_export"):
-        ckptr.save(path, payload, force=True)
-        ckptr.wait_until_finished()
-    ckptr.close()
+        try:
+            ckptr.save(path, payload, force=True)
+            ckptr.wait_until_finished()
+        finally:
+            # abort path included: never orphan the async write thread
+            ckptr.close()
     log.info("model exported to %s", path)
     return path
 
@@ -98,25 +347,69 @@ def load_train_checkpoint(model_dir: str, step: Optional[int] = None):
     checkpoint's own metadata), so a serving process does not need the
     training run's optimizer/loss-scale configuration — including
     ZeRO-sharded runs, whose sliced optimizer state is simply dropped.
-    Returns None when ``model_dir`` has no checkpoint."""
+    Returns None when ``model_dir`` has no checkpoint.
+
+    Same integrity fallback as the trainer's restore: a corrupt or
+    mid-write newest step (the training run may still be saving, or
+    died saving) falls back to the newest verified step with a
+    structured anomaly — a serving process never crashes on a torn
+    checkpoint it can route around."""
     directory = os.path.abspath(os.path.join(model_dir, "checkpoints"))
     if not os.path.isdir(directory):
         return None
-    mgr = ocp.CheckpointManager(directory)
+    # enumerate step dirs directly rather than through CheckpointManager:
+    # the manager infers the run's ITEM layout from the union of every
+    # step directory, so one junk/mid-write step dir (a loose file where
+    # it expects an item) poisons restores of the GOOD steps too.
+    # Per-step StandardCheckpointer restores are isolated: a broken step
+    # fails only itself and the fallback walks on.
     try:
-        step = mgr.latest_step() if step is None else step
-        if step is None:
-            return None
-        with trace.span("checkpoint_restore", step=int(step)):
-            restored = mgr.restore(step, args=ocp.args.StandardRestore())
-    finally:
-        mgr.close()
+        steps = sorted((int(name) for name in os.listdir(directory)
+                        if name.isdigit()
+                        and os.path.isdir(os.path.join(directory, name))),
+                       reverse=True)
+    except OSError:
+        return None
+    candidates = [int(step)] if step is not None else steps
+    restored, used_step = None, None
+    for s in candidates:
+        verdict = verify_step(directory, s)
+        if verdict == "corrupt":
+            trace.anomaly("ckpt_integrity", step=s, verdict=verdict,
+                          action="raise" if step is not None
+                          else "fallback")
+            if step is not None:
+                raise OSError(
+                    f"checkpoint step {s} under {directory} failed "
+                    f"integrity verification")
+            log.error("serve bridge: checkpoint step %d failed "
+                      "verification — falling back", s)
+            continue
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            with trace.span("checkpoint_restore", step=s):
+                restored = ckptr.restore(
+                    os.path.join(directory, str(s), "default"))
+            used_step = s
+            break
+        except Exception as e:  # noqa: BLE001
+            if step is not None:
+                raise
+            trace.anomaly("ckpt_integrity", step=s, verdict="unreadable",
+                          error=type(e).__name__, action="fallback")
+            log.error("serve bridge: checkpoint step %d unreadable "
+                      "(%s) — falling back", s, type(e).__name__)
+            continue
+        finally:
+            ckptr.close()
+    if used_step is None:
+        return None
     if not isinstance(restored, dict) or "params" not in restored:
         raise ValueError(
-            f"checkpoint at {directory} step {step} is not a TrainState "
+            f"checkpoint at {directory} step {used_step} is not a TrainState "
             f"(keys: {sorted(restored) if isinstance(restored, dict) else type(restored)})")
     log.info("serve bridge: loaded train checkpoint step %s from %s",
-             step, directory)
+             used_step, directory)
     return {"params": restored["params"],
             "batch_stats": restored.get("batch_stats") or {}}
 
@@ -132,14 +425,61 @@ def load_exported_model(export_dir: str) -> dict:
 
 
 class CheckpointCallback:
-    """Per-epoch save — the ModelCheckpoint-callback equivalent."""
+    """Per-epoch save (the ModelCheckpoint-callback equivalent), plus:
 
-    def __init__(self, model_dir: str, max_to_keep: int = 3):
+      every_steps  — synchronous sealed saves every N global steps (the
+          preemption-granularity knob: a pod whose ranks can vanish any
+          minute should not rely on epoch boundaries)
+      on_preempt   — the emergency save the loop triggers at the step
+          boundary after SIGTERM/SIGINT: save + wait + manifest, so the
+          checkpoint is durable before the process exits EXIT_PREEMPTED
+      host_state_fn(step) — host-side resume payload (data position,
+          seed) carried in each save's manifest
+    """
+
+    def __init__(self, model_dir: str, max_to_keep: int = 3,
+                 every_steps: int = 0, host_state_fn=None):
         self.ckpt = Checkpointer(model_dir, max_to_keep=max_to_keep)
+        self.every_steps = int(every_steps or 0)
+        self.host_state_fn = host_state_fn
+
+    def _host(self, step: int) -> Optional[dict]:
+        if self.host_state_fn is None:
+            return {"global_step": int(step)}
+        payload = dict(self.host_state_fn(int(step)) or {})
+        payload.setdefault("global_step", int(step))
+        return payload
+
+    def on_batch_end(self, batch: int, logs=None):
+        if not self.every_steps or not logs or "state" not in logs:
+            return
+        step = int(logs["step"])
+        if step and step % self.every_steps == 0:
+            self.ckpt.save(logs["state"], step=step,
+                           host_state=self._host(step), sync=True)
 
     def on_epoch_end(self, epoch: int, logs=None):
         if logs and "state" in logs:
-            self.ckpt.save(logs["state"])
+            step = int(jax.device_get(logs["state"].step))
+            if self.ckpt.latest_step() == step:
+                return  # an interval save already sealed this boundary
+            # ASYNC, like the pre-manifest behavior: the epoch-boundary
+            # save overlaps the next epoch's steps; its manifest seals
+            # at the next wait() (train end / preempt / close).  A
+            # crash in that window leaves the step "unverified" — still
+            # restorable, just not digest-guaranteed.  Only interval
+            # and preemption saves pay for synchronous durability.
+            self.ckpt.save(logs["state"], host_state=self._host(step))
+
+    def on_preempt(self, logs=None):
+        if not logs or "state" not in logs:
+            return
+        step = int(logs["step"])
+        if self.ckpt.latest_step() == step:
+            self.ckpt.wait()  # already saved this boundary — just seal
+            return
+        self.ckpt.save(logs["state"], step=step,
+                       host_state=self._host(step), sync=True)
 
     def on_train_end(self, logs=None):
         self.ckpt.wait()

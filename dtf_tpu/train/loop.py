@@ -36,6 +36,7 @@ from jax import lax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from dtf_tpu import chaos
 from dtf_tpu.config import Config
 from dtf_tpu.data.base import DatasetSpec
 from dtf_tpu.models.partition import spec_axes as _spec_axes
@@ -45,6 +46,7 @@ from dtf_tpu.obs.watchdog import (Heartbeat, NanLossWatchdog,
                                   StepTimeWatchdog)
 from dtf_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
                                   MeshRuntime)
+from dtf_tpu.train import preemption
 from dtf_tpu.train import schedules as sched_lib
 from dtf_tpu.train.optimizer import build_optimizer
 from dtf_tpu.utils.logs import TimeHistory, build_stats
@@ -833,14 +835,23 @@ class Trainer:
         global_step = resumed_step
         start_epoch = (global_step // self.steps_per_epoch
                        if self.steps_per_epoch else 0)
-        if start_epoch:
-            log.info("resuming at step %d (epoch %d)", global_step, start_epoch)
+        # crash-exact mid-epoch resume: a run restored at step K of
+        # epoch E continues at batch K%spe — it must neither re-train
+        # the epoch prefix nor consume those batches from the (already
+        # repositioned) data stream
+        start_batch = (global_step % self.steps_per_epoch
+                       if self.steps_per_epoch else 0)
+        if global_step:
+            log.info("resuming at step %d (epoch %d, batch %d)",
+                     global_step, start_epoch, start_batch)
         t0 = time.time()
         try:
             for epoch in range(start_epoch, self.train_epochs):
                 for cb in callbacks:
                     _call(cb, "on_epoch_begin", epoch, None)
-                for batch_idx in range(self.steps_per_epoch):
+                for batch_idx in range(
+                        start_batch if epoch == start_epoch else 0,
+                        self.steps_per_epoch):
                     for cb in callbacks:
                         _call(cb, "on_batch_begin", batch_idx, None)
                     if (profile_range and not profile_started
@@ -875,6 +886,12 @@ class Trainer:
                         # return early on some remote platforms
                         loss_val = jax.device_get(metrics["loss"])
                         nan_guard.check(global_step, float(loss_val))
+                        # the loss trajectory record: Python floats
+                        # round-trip JSON exactly, so the chaos suite's
+                        # crash-exactness asserts compare these
+                        # bit-identically across killed+resumed runs
+                        trace.event("train_loss", step=global_step,
+                                    loss=float(loss_val))
                         now = time.monotonic()
                         if not window_skewed:
                             # the one host-measured duration that spans a
@@ -894,8 +911,30 @@ class Trainer:
                     if profiling and global_step > profile_range[1]:
                         jax.profiler.stop_trace()
                         profiling = False
+                    # interval checkpointing reads state/step from the
+                    # logs dict (CheckpointCallback.every_steps)
                     for cb in callbacks:
-                        _call(cb, "on_batch_end", batch_idx, None)
+                        _call(cb, "on_batch_end", batch_idx,
+                              {"state": state, "step": global_step})
+                    # chaos probe AFTER the interval checkpoint sealed:
+                    # crash@step:K with checkpoint_steps dividing K is
+                    # the deterministic kill-after-durable-save
+                    # experiment (tests/test_chaos.py)
+                    chaos.step(global_step)
+                    signum = preemption.triggered()
+                    if signum is not None:
+                        # preemption (SIGTERM/SIGINT): emergency
+                        # checkpoint at this step boundary — save +
+                        # wait + integrity manifest — then the distinct
+                        # preempted exit the supervisor restarts
+                        # without consuming the crash budget
+                        for cb in callbacks:
+                            _call(cb, "on_preempt",
+                                  {"state": state, "step": global_step})
+                        trace.event("preempted", step=global_step,
+                                    signum=int(signum))
+                        trace.flush()
+                        raise preemption.Preempted(global_step, signum)
                 # epoch end: materialize the last step's metrics (keras history
                 # records per-epoch training metrics)
                 m = jax.device_get(metrics)

@@ -352,8 +352,9 @@ def test_nan_guard_aborts_training_e2e(tmp_path, monkeypatch):
     from dtf_tpu.cli import runner as runner_mod
     from dtf_tpu.data import synthetic_input_fn as real_synth
 
-    def poisoned(spec, train, batch, seed):
-        for images, labels in real_synth(spec, train, batch, seed):
+    def poisoned(spec, train, batch, seed, start_step=0):
+        for images, labels in real_synth(spec, train, batch, seed,
+                                         start_step=start_step):
             yield np.full_like(images, np.nan), labels
 
     monkeypatch.setattr(runner_mod, "synthetic_input_fn", poisoned)
@@ -369,8 +370,9 @@ def test_nan_guard_can_be_disabled(monkeypatch):
     from dtf_tpu.cli import runner as runner_mod
     from dtf_tpu.data import synthetic_input_fn as real_synth
 
-    def poisoned(spec, train, batch, seed):
-        for images, labels in real_synth(spec, train, batch, seed):
+    def poisoned(spec, train, batch, seed, start_step=0):
+        for images, labels in real_synth(spec, train, batch, seed,
+                                         start_step=start_step):
             yield np.full_like(images, np.nan), labels
 
     monkeypatch.setattr(runner_mod, "synthetic_input_fn", poisoned)

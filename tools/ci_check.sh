@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # One-command CI contract: tier-1 suite + test-budget audit + traced
-# smoke run + anomaly cleanliness.
+# smoke run + anomaly cleanliness + chaos smoke (kill → resume →
+# trajectory-exactness).
 #
 # Before this script the repo had two CONVENTIONS instead of one
 # command: "run tools/marker_audit.py after the suite" (the test-budget
@@ -19,9 +20,16 @@
 #   4. python -m dtf_tpu.cli.trace_main <dir> --check — exits nonzero
 #      on ANY anomaly record (nan_loss, step_time_regression,
 #      serve_shed, ...).
+#   5. tools/chaos_smoke.py — the fault-tolerance contract: a run
+#      killed by an injected crash (dtf_tpu/chaos) under the
+#      cli/launch.py supervisor resumes to a BIT-IDENTICAL loss
+#      trajectory, and `trace_main --check --allow injected_fault`
+#      proves the trace contains the injected fault and nothing else.
+#      (The long kill-matrix variants live in tests/test_chaos.py,
+#      marked `slow`.)
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-4 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-5 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -29,18 +37,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/4]: tier-1 test suite =="
+    echo "== ci_check [1/5]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/4]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/5]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/4]: marker audit (test-budget contract) =="
+echo "== ci_check [2/5]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/4]: traced smoke run =="
+echo "== ci_check [3/5]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -48,7 +56,10 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/4]: anomaly cleanliness =="
+echo "== ci_check [4/5]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
+
+echo "== ci_check [5/5]: chaos smoke (kill -> resume -> exactness) =="
+python tools/chaos_smoke.py
 
 echo "ci_check: OK"
