@@ -102,11 +102,19 @@ def read_heartbeat(path: str):
 
 def build_env(rank: int, world: int, coordinator: str,
               devices_per_process: Optional[int] = None,
-              heartbeat_dir: Optional[str] = None) -> dict:
+              heartbeat_dir: Optional[str] = None,
+              generation: int = 0) -> dict:
     env = dict(os.environ)
     env["DTF_COORDINATOR"] = coordinator
     env["DTF_PROCESS_ID"] = str(rank)
     env["DTF_PROCESS_COUNT"] = str(world)
+    # restart generation (= supervisor attempt): the async-PS snapshot
+    # tags its done_count with this, so a whole-job restart discards
+    # the stale generation's DONE tally instead of double-counting it
+    # (dtf_tpu/parallel/ps.py GENERATION_ENV — duplicated string for
+    # the same stdlib-only reason as the contracts above; parity is
+    # pinned by tests/test_ps.py)
+    env["DTF_RESTART_GENERATION"] = str(generation)
     if heartbeat_dir:
         # ranks running dtf_tpu mains rewrite
         # <log_dir>/heartbeat_rank{N}.json at a bounded interval
@@ -168,7 +176,8 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
             p = subprocess.Popen(
                 cmd, env=build_env(rank, num_processes, coordinator,
                                    devices_per_process,
-                                   heartbeat_dir=log_dir),
+                                   heartbeat_dir=log_dir,
+                                   generation=attempt),
                 stdout=f, stderr=subprocess.STDOUT)
             procs.append((rank, p))
             last_beat[rank] = spawned[rank] = time.monotonic()

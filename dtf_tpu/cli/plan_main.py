@@ -1,0 +1,311 @@
+"""Parallelism-planner CLI — rank, check, and calibrate plans.
+
+Rank the feasible plan lattice for a workload on a (possibly simulated)
+mesh — plans for a 4-host × 4-device pod are computed on a CPU box:
+
+  python -m dtf_tpu.cli.plan_main --model transformer_tpu --dataset lm \
+      --seq_len 2048 --batch_size 256 --dtype bf16 --optimizer adamw \
+      --plan_mesh 4x4 --top 10 --out plans.json
+
+Verify that every plan the ranker calls feasible actually compiles
+(one smoke train step per plan, on the live devices):
+
+  python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
+      --dataset lm --seq_len 64 --batch_size 8 --check --check_top 3
+
+Calibration: run a short MEASURED smoke and record predicted-vs-measured
+step time and live bytes into the obs registry (and, with
+``--benchmark_log_dir``, into metric.log via
+``BenchmarkFileLogger.log_registry``); exits nonzero when the ratio
+leaves ``--calibrate_tolerance`` (the ci_check stage-6 contract):
+
+  python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
+      --seq_len 64 --batch_size 4 --optimizer adamw --calibrate
+
+``--plan <file>`` evaluates that one plan instead of searching;
+memory-infeasible plans are rejected loudly (exit 2).
+
+All ordinary dtf flags (--model/--dataset/--batch_size/--seq_len/
+--dtype/--optimizer/--plan_mesh/...) are accepted; the planner-only
+options are --devices/--top/--out/--check/--check_top/--calibrate/
+--calibrate_steps/--calibrate_tolerance.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# --devices N: virtual host-platform devices for --check smokes (the
+# tests' 8-device CPU mesh).  Must land in XLA_FLAGS before the jax
+# backend initializes — honored here, ahead of every other import.
+if "--devices" in sys.argv:
+    _n = int(sys.argv[sys.argv.index("--devices") + 1])
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={_n}")
+
+import json     # noqa: E402
+import logging  # noqa: E402
+import tempfile  # noqa: E402
+
+from dtf_tpu.config import parse_flags  # noqa: E402
+
+log = logging.getLogger("dtf_tpu")
+
+_OWN_FLAGS = {
+    # name: (takes_value, default)
+    "devices": (True, None),
+    "top": (True, 10),
+    "out": (True, ""),
+    "check": (False, False),
+    "check_top": (True, 3),
+    "calibrate": (False, False),
+    "calibrate_steps": (True, 8),
+    "calibrate_tolerance": (True, 2.0),
+}
+
+
+def _split_args(argv):
+    """Extract plan_main-only options; the rest is ordinary dtf flags."""
+    own = {k: v[1] for k, v in _OWN_FLAGS.items()}
+    rest = []
+    i = 0
+    while i < len(argv):
+        name = argv[i].lstrip("-")
+        if argv[i].startswith("-") and name in _OWN_FLAGS:
+            takes_value = _OWN_FLAGS[name][0]
+            if takes_value:
+                raw = argv[i + 1]
+                own[name] = (float(raw) if name == "calibrate_tolerance"
+                             else raw if name == "out" else int(raw))
+                i += 2
+            else:
+                own[name] = True
+                i += 1
+        else:
+            rest.append(argv[i])
+            i += 1
+    return own, rest
+
+
+def _smoke_config(cfg, train_steps: int, model_dir: str):
+    """A measured/compile smoke derived from the workload config: tiny
+    step count, synthetic-friendly, no checkpoint/eval side effects."""
+    return cfg.replace(
+        train_steps=train_steps, train_epochs=1, log_steps=1,
+        model_dir=model_dir, skip_checkpoint=True, skip_eval=True,
+        clean=False, resume=False, benchmark_log_dir="")
+
+
+def _check(cfg, ranked, check_top: int) -> int:
+    """Compile one smoke train step for each feasible-marked plan (top
+    ``check_top``); nonzero exit when any of them fails — a plan the
+    model calls feasible MUST compile, that is the contract."""
+    import jax
+
+    from dtf_tpu.cli.runner import run
+    from dtf_tpu.plan import apply_plan
+
+    live = jax.device_count()
+    failures = 0
+    # cap BEFORE the device-count test: checking a simulated mesh
+    # larger than this box must report check_top clear failures, not
+    # one "cannot check" line per feasible plan in the lattice
+    to_check = [r for r in ranked if r.feasible][:check_top]
+    for r in to_check:
+        if r.plan.num_devices > live:
+            print(f"plan {r.plan.describe()}: needs {r.plan.num_devices} "
+                  f"devices, {live} attached — cannot check on this box",
+                  file=sys.stderr)
+            failures += 1
+            continue
+        with tempfile.TemporaryDirectory() as tmp:
+            try:
+                # apply_plan inside the guard: a hand-set plan-owned
+                # flag (e.g. --check under a pinned --remat) reports
+                # per-plan FAILED lines, not one uncaught traceback
+                smoke = _smoke_config(apply_plan(cfg, r.plan), 1, tmp)
+                run(smoke)
+                print(f"check {r.plan.describe()}: OK")
+            except Exception as e:  # noqa: BLE001 — report, keep checking
+                failures += 1
+                print(f"check {r.plan.describe()}: FAILED "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
+    if not to_check:
+        print("check: no feasible plan to check", file=sys.stderr)
+        return 1
+    return 1 if failures else 0
+
+
+def _calibrate(cfg, stats, mesh, plan, steps: int, tolerance: float) -> int:
+    """Measured smoke vs prediction.  Records, per the obs-registry
+    contract: plan_predicted_step_s, plan_measured_step_s,
+    plan_step_time_ratio, plan_predicted_peak_bytes,
+    plan_measured_live_bytes, plan_live_bytes_ratio — exported through
+    BenchmarkFileLogger.log_registry when --benchmark_log_dir is set."""
+    import jax
+
+    from dtf_tpu.cli.runner import run
+    from dtf_tpu.obs.registry import default_registry
+    from dtf_tpu.plan import apply_plan, predict
+    from dtf_tpu.plan.mesh_spec import calibrate_device_flops
+
+    # measured achievable FLOP/s replaces the preset's guess: the ratio
+    # then compares the MODEL (traffic/FLOP accounting), not whether
+    # the preset knew this box's matmul speed
+    from dtf_tpu.plan.compile import PLAN_OWNED_FLAGS
+
+    measured_flops = calibrate_device_flops()
+    cost = predict(plan, stats, mesh, cfg.batch_size,
+                   optimizer=cfg.optimizer, device_flops=measured_flops)
+    # calibrating a hand-flagged config: the plan was DERIVED from the
+    # plan-owned flags (plan_from_config), so reset them to defaults
+    # before apply_plan writes them back — otherwise its hand-set-flag
+    # conflict guard rejects the very flags the plan encodes
+    run_cfg = cfg.replace(plan="", **PLAN_OWNED_FLAGS)
+    run_cfg = apply_plan(run_cfg, plan)
+    benchmark_dir = cfg.benchmark_log_dir
+    with tempfile.TemporaryDirectory() as tmp:
+        stats_out = run(_smoke_config(run_cfg, steps, tmp))
+    reg = default_registry()
+    gauge = reg.get("train_step_s")
+    if gauge is not None and gauge.value > 0:
+        measured_step = float(gauge.value)
+    elif stats_out.get("avg_exp_per_second"):
+        measured_step = cfg.batch_size / stats_out["avg_exp_per_second"]
+    else:
+        print("calibrate: the smoke produced no step-time measurement "
+              "(too few steps?)", file=sys.stderr)
+        return 1
+    live_gauge = reg.get("train_live_bytes")
+    measured_live = float(live_gauge.value) if live_gauge else 0.0
+
+    ratio = cost.step_time_s / measured_step
+    reg.gauge("plan_predicted_step_s", unit="s").set(cost.step_time_s)
+    reg.gauge("plan_measured_step_s", unit="s").set(measured_step)
+    reg.gauge("plan_step_time_ratio").set(ratio)
+    reg.gauge("plan_predicted_peak_bytes", unit="bytes").set(
+        cost.peak_bytes)
+    if measured_live:
+        reg.gauge("plan_measured_live_bytes", unit="bytes").set(
+            measured_live)
+        reg.gauge("plan_live_bytes_ratio").set(
+            cost.peak_bytes / measured_live)
+    print(f"calibration ({plan.describe()}, device_flops "
+          f"{measured_flops:.3g}):")
+    print(f"  step time: predicted {cost.step_time_s * 1e3:.2f} ms, "
+          f"measured {measured_step * 1e3:.2f} ms  "
+          f"(ratio {ratio:.2f})")
+    if measured_live:
+        print(f"  memory: predicted peak {cost.peak_bytes / 2**20:.1f} "
+              f"MiB, measured live {measured_live / 2**20:.1f} MiB")
+    if benchmark_dir and jax.process_index() == 0:
+        from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
+        blog = BenchmarkFileLogger(benchmark_dir)
+        blog.log_registry(reg)
+        print(f"  registry exported to {benchmark_dir}/metric.log")
+    if not (1.0 / tolerance <= ratio <= tolerance):
+        print(f"calibrate: predicted/measured step-time ratio {ratio:.2f} "
+              f"outside [{1 / tolerance:.2f}, {tolerance:.2f}] — the "
+              f"cost model is off for this workload/box", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    own, rest = _split_args(list(sys.argv[1:] if argv is None else argv))
+    cfg = parse_flags(rest)
+    if not cfg.model or not cfg.dataset:
+        print("plan_main needs --model and --dataset (the workload to "
+              "plan)", file=sys.stderr)
+        return 2
+
+    from dtf_tpu.plan import (check_plan, load_plan_file, plan_from_config,
+                              predict, search)
+    from dtf_tpu.plan.compile import stats_for_config
+    from dtf_tpu.plan.mesh_spec import mesh_spec
+    from dtf_tpu.plan.search import RankedPlan, ranked_artifact
+
+    stats = stats_for_config(cfg)
+    mesh = mesh_spec(cfg.plan_mesh)
+
+    if cfg.plan and cfg.plan != "auto":
+        # evaluate ONE explicit plan (still printed as a 1-row ranking)
+        plan = load_plan_file(cfg.plan)
+        violations = tuple(check_plan(plan, stats, mesh, cfg.batch_size))
+        cost = predict(plan, stats, mesh, cfg.batch_size,
+                       optimizer=cfg.optimizer)
+        ranked = [RankedPlan(plan, cost, violations)]
+    else:
+        ranked = search(stats, mesh, cfg.batch_size,
+                        optimizer=cfg.optimizer)
+
+    feasible = sum(1 for r in ranked if r.feasible)
+    print(f"{stats.model} ({stats.params / 1e6:.1f}M params"
+          + (f", seq {stats.seq_len}" if stats.seq_len else "")
+          + f") × batch {cfg.batch_size} on {mesh.name} "
+          f"({mesh.num_hosts}×{mesh.devices_per_host} devices, "
+          f"{mesh.hbm_bytes / 2**30:.0f} GiB HBM): "
+          f"{feasible}/{len(ranked)} plans feasible")
+    hdr = (f"{'rank':>4} {'plan':<34} {'step_ms':>9} {'peak_GiB':>9} "
+           f"{'verdict':<10}")
+    print(hdr)
+    print("-" * len(hdr))
+    for i, r in enumerate(ranked[:own["top"]], start=1):
+        verdict = ("ok" if r.feasible
+                   else ("invalid" if r.violations else "over-mem"))
+        print(f"{i:>4} {r.plan.describe():<34} "
+              f"{r.cost.step_time_s * 1e3:>9.2f} "
+              f"{r.cost.peak_bytes / 2**30:>9.3f} {verdict:<10}")
+        for v in r.violations:
+            print(f"       ! {v}")
+
+    if own["out"]:
+        artifact = ranked_artifact(stats, mesh, cfg.batch_size, ranked,
+                                   top=own["top"])
+        with open(own["out"], "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        print(f"ranked artifact written to {own['out']}")
+
+    rc = 0
+    if cfg.plan == "auto" and not feasible:
+        # the runner's best_plan rejects this loudly; the CLI must not
+        # exit 0 on an all-infeasible lattice — and --calibrate below
+        # must never pick (and run!) the least-over-budget plan
+        near = min(ranked, key=lambda r: r.cost.peak_bytes, default=None)
+        print(f"plan auto REJECTED: no feasible plan"
+              + (f" — smallest predicted peak "
+                 f"{near.cost.peak_bytes / 2**30:.2f} GiB "
+                 f"({near.plan.describe()}) vs budget "
+                 f"{near.cost.hbm_budget_bytes / 2**30:.2f} GiB"
+                 if near else ""), file=sys.stderr)
+        return 2
+    if cfg.plan and cfg.plan != "auto":
+        r = ranked[0]
+        if r.violations:
+            print(f"plan REJECTED (invalid): {'; '.join(r.violations)}",
+                  file=sys.stderr)
+            return 2
+        if not r.cost.feasible:
+            print(f"plan REJECTED (memory-infeasible): predicted peak "
+                  f"{r.cost.peak_bytes / 2**30:.2f} GiB/device exceeds "
+                  f"budget {r.cost.hbm_budget_bytes / 2**30:.2f} GiB",
+                  file=sys.stderr)
+            return 2
+    if own["check"]:
+        rc = rc or _check(cfg.replace(plan=""), ranked, own["check_top"])
+    if own["calibrate"]:
+        plan = (ranked[0].plan if cfg.plan
+                else plan_from_config(cfg, mesh.num_devices))
+        rc = rc or _calibrate(cfg, stats, mesh, plan,
+                              own["calibrate_steps"],
+                              own["calibrate_tolerance"])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

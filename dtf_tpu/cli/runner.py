@@ -129,6 +129,21 @@ def run(cfg: Config) -> dict:
 
 
 def _run(cfg: Config) -> dict:
+    if cfg.plan:
+        # --plan auto|<file>: compile the chosen plan into the ordinary
+        # parallelism flags BEFORE anything reads them — from here on
+        # the run is indistinguishable from the same flags set by hand
+        # (bit-identical, tests/test_plan.py).  Infeasible plans die
+        # here, loudly, not as an OOM mid-compile.
+        # resolve_plan queries the live topology (mesh_spec("") and the
+        # attached-device guard), which initializes the jax backend —
+        # in a multi-process run the distributed rendezvous must come
+        # first, or process_count() reports 1 and the later
+        # jax.distributed.initialize refuses an initialized backend
+        from dtf_tpu.runtime.mesh import _maybe_init_distributed
+        _maybe_init_distributed(cfg)
+        from dtf_tpu.plan import resolve_plan
+        cfg = resolve_plan(cfg)
     # structured tracing: --trace_dir, or DTF_TRACE_DIR forwarded by the
     # launcher to every rank (idempotent when a main already configured)
     from dtf_tpu.obs import trace
@@ -309,7 +324,7 @@ def _run(cfg: Config) -> dict:
                      "start_step": step}}
         ckpt_cb = ckpt_mod.CheckpointCallback(
             cfg.model_dir, every_steps=cfg.checkpoint_steps,
-            host_state_fn=host_state_fn)
+            host_state_fn=host_state_fn, keep=cfg.checkpoint_keep)
         if cfg.resume:
             # restore with the state's own per-leaf shardings (TP/EP/PP
             # states are not replicated — a blanket replicated sharding

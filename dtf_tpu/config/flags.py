@@ -236,6 +236,28 @@ class Config:
     # None (default) = 4 pages, valid at ANY page size
     serve_prefill_chunk: Optional[int] = None
 
+    # --- parallelism planner (dtf_tpu/plan) ---
+    # "" = off (hand-set flags rule, the pre-planner behavior);
+    # "auto" = search the feasible plan lattice on --plan_mesh and
+    # compile the fastest predicted plan into the parallelism flags;
+    # <path> = a plan JSON (plan_main --out artifact, a {"plan": ...}
+    # wrapper, or a bare plan object).  A plan-selected run is
+    # bit-identical to the same flags set by hand (tests/test_plan.py);
+    # plan-owned flags (--model_parallelism & co.) must stay at their
+    # defaults when --plan is given — conflicts are loud errors.
+    plan: str = ""
+    # mesh descriptor the planner costs against: "" = the live runtime
+    # topology, a preset (cpu | v4-8 | 4x4), or an explicit
+    # "hosts=4,devices=4,hbm=32g,flops=140t,intra=100g,inter=25g"
+    plan_mesh: str = ""
+    # cross-run checkpoint GC by verified-set (train/checkpoint.py
+    # Checkpointer.gc): after training, delete all but the newest N
+    # sha256-VERIFIED steps (steps newer than the newest verified one —
+    # e.g. an in-flight unsealed save — are never touched; with no
+    # verified step at all nothing is deleted).  0 = off (orbax's
+    # in-run max_to_keep still applies)
+    checkpoint_keep: int = 0
+
     # --- observability (dtf_tpu/obs) ---
     # structured JSONL tracing: each process writes
     # <trace_dir>/trace_rank{N}.jsonl (step/compile/checkpoint/ps/serve
@@ -359,6 +381,21 @@ class Config:
             raise ValueError(
                 f"checkpoint_steps must be >= 0 (0 = per-epoch only), "
                 f"got {self.checkpoint_steps}")
+        if self.checkpoint_keep < 0:
+            raise ValueError(
+                f"checkpoint_keep must be >= 0 (0 = no cross-run GC), "
+                f"got {self.checkpoint_keep}")
+        if self.plan and self.plan != "auto" and not os.path.exists(self.plan):
+            # fail at flag-parse time, not after dataset/model setup
+            raise ValueError(
+                f"--plan {self.plan!r}: no such plan file (pass 'auto' "
+                f"to search, or a plan_main --out JSON artifact)")
+        if self.plan_mesh:
+            # typo'd presets/descriptors fail at flag-parse time, not
+            # mid-resolution (mesh_spec never touches jax for a
+            # non-empty spec, so this stays import-light)
+            from dtf_tpu.plan.mesh_spec import mesh_spec
+            mesh_spec(self.plan_mesh)
         if self.fault:
             # fail at flag-parse time, not at the step the typo'd fault
             # silently never fires

@@ -138,6 +138,97 @@ class StaleNativeLib(OSError):
 SNAP_MAGIC = b"DTFPSNP1"
 SNAP_FOOTER_MAGIC = b"DTFPSDN1"
 
+# Restart-generation tag for the snapshot's done_count footer.  The
+# done_count persistence exists for a PS-only crash (workers survive,
+# reconnect, and their already-delivered DONEs must still count on the
+# restarted store).  A WHOLE-JOB supervisor restart is different: every
+# worker re-runs from the top and will deliver DONE again, so a
+# restored tally from the previous attempt double-counts — the PS
+# rank's wait(num_workers) returns early while re-run workers still
+# push.  The launch.py supervisor exports DTF_RESTART_GENERATION (its
+# attempt counter) to every rank; the snapshot loop tags each dump with
+# the generation it was taken under (a sidecar next to the snapshot —
+# the snapshot payload itself stays byte-compatible with both store
+# builds), and a restore under a NEWER generation strips the done_count
+# footer before handing the file to the store: params/velocity/version
+# survive, the stale generation's DONE tally does not.
+GENERATION_ENV = "DTF_RESTART_GENERATION"
+
+
+def current_generation() -> int:
+    """This process's restart generation (supervisor attempt number);
+    0 when unsupervised or on the first attempt."""
+    try:
+        return int(os.environ.get(GENERATION_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+def _generation_sidecar(snap_path: str) -> str:
+    return snap_path + ".gen"
+
+
+def read_snapshot_generation(snap_path: str) -> int:
+    """Generation a snapshot was taken under; 0 for pre-generation
+    (sidecar-less) snapshots — those predate supervised restarts and
+    restore with the legacy semantics."""
+    try:
+        with open(_generation_sidecar(snap_path)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+def write_snapshot_generation(snap_path: str, generation: int) -> bool:
+    """Atomically record the generation claim.  Returns False on a
+    write failure — the caller must then SKIP the snapshot dump: a
+    fresh snapshot under a stale sidecar is exactly the state a
+    same-generation restore would wrongly strip."""
+    path = _generation_sidecar(snap_path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            f.write(str(int(generation)))
+        os.replace(tmp, path)
+    except OSError as e:
+        log.warning("PS snapshot generation sidecar write failed: %s", e)
+        return False
+    return True
+
+
+def strip_done_footer(snap_path: str) -> bool:
+    """Rewrite a snapshot WITHOUT its done_count footer (both stores
+    restore footer-less files with the tally at 0).  In place, atomic.
+    Returns True when a footer was present and stripped; a malformed
+    file is left untouched (restore will quarantine it)."""
+    try:
+        with open(snap_path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    if len(data) < 24 or data[:8] != SNAP_MAGIC:
+        return False
+    (n,) = struct.unpack("<Q", data[16:24])
+    base = 24 + 8 * n
+    if (len(data) != base + 16
+            or data[base:base + 8] != SNAP_FOOTER_MAGIC):
+        return False
+    tmp = f"{snap_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data[:base])
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, snap_path)
+    except OSError as e:
+        # a write failure (read-only dir, disk full) must not crash the
+        # restarting PS rank — restore proceeds with the stale tally,
+        # loudly (the lesser evil: early wait() return vs a crash loop)
+        log.warning("PS snapshot: could not strip stale done_count "
+                    "footer (%s) — restoring WITH the stale tally", e)
+        return False
+    return True
+
 # Reconnect-reseed guard floor (see PsClient): with fewer than this
 # many versions seen, a reconnecting worker may still re-seed an
 # uninitialized restarted store — the legitimate pre-first-snapshot
@@ -848,9 +939,23 @@ class _SnapshotLoop:
             self._thread = None
             return
         if os.path.exists(self.path):
+            gen, snap_gen = current_generation(), \
+                read_snapshot_generation(self.path)
+            if snap_gen != gen and strip_done_footer(self.path):
+                # whole-job restart (new supervisor attempt): the
+                # persisted DONE tally belongs to workers of the STALE
+                # generation — they re-run and re-deliver; counting the
+                # old tally would double-count and let wait(num_workers)
+                # return early.  Params/velocity/version still restore.
+                log.warning(
+                    "PS rank: snapshot done_count is from restart "
+                    "generation %d (this attempt is generation %d) — "
+                    "discarded; re-run workers re-deliver their DONEs",
+                    snap_gen, gen)
             try:
                 server.restore(self.path)
-                log.info("PS rank: restored snapshot %s", self.path)
+                log.info("PS rank: restored snapshot %s (generation %d)",
+                         self.path, gen)
             except OSError as e:
                 quarantine = self.path + ".corrupt"
                 log.error("PS rank: snapshot %s unusable (%s) — moved "
@@ -881,6 +986,19 @@ class _SnapshotLoop:
     def _snap(self) -> str:
         """"saved" | "uninit" | "ioerror" (logged)."""
         try:
+            # sidecar FIRST: a crash between the two writes must never
+            # leave a new snapshot under-claimed by an old sidecar — a
+            # same-generation restore would then strip a legitimate
+            # done_count and wait(num_workers) would hang.  The inverse
+            # window (new sidecar + old snapshot) is safe: any stale-
+            # generation footer was already stripped in place at this
+            # loop's restore, so an on-disk footer is always ours.  A
+            # FAILED sidecar write skips the dump for the same reason —
+            # dumping anyway would recreate the old-sidecar/new-
+            # snapshot state the ordering exists to prevent.
+            if not write_snapshot_generation(self.path,
+                                             current_generation()):
+                return "ioerror"
             self.server.snapshot(self.path)
             return "saved"
         except ValueError:

@@ -1,0 +1,47 @@
+"""Parallelism planner — analytic cost/memory model over the strategy
+space, feasible-plan search, and plan→config compilation.
+
+The repo exposes every parallelism lever the cluster experiments
+motivate — 'model'/'seq' mesh axes, ZeRO-1 optimizer sharding, pipeline
+stages, gradient accumulation, remat — but until this package choosing
+among them was operator folklore: flags were hand-tuned per run and a
+bad combination only revealed itself as an OOM or a 2× step-time
+regression on real hardware.  Following AMP (arXiv:2210.07297) and
+DistIR (arXiv:2111.05426), an analytic cost+memory model over the plan
+lattice picks near-optimal plans without touching the accelerators:
+plans for a 4-host × 4-device pod are computed on a CPU box in
+milliseconds.
+
+Layers:
+  mesh_spec   — MeshSpec: devices, HBM, achievable FLOP/s, intra/inter
+                host bandwidth (presets + "k=v,…" parser + a live-probe
+                FLOP/s calibration)
+  model_stats — per-layer param counts, forward FLOPs and activation
+                bytes derived from the registry's model configs
+                (transformer + resnet families)
+  cost_model  — Plan dataclass (data/model/seq × zero × pipeline ×
+                microbatch × remat) → predicted step time + peak HBM,
+                both pure functions
+  search      — enumerate the feasible lattice under the HBM budget,
+                rank by predicted step time, emit a ranked JSON artifact
+  compile     — Plan ↔ the existing config flags (`--plan auto|<file>`);
+                a plan-selected run is bit-identical to the same flags
+                set by hand (test-asserted)
+
+CLI: ``python -m dtf_tpu.cli.plan_main`` (rank / --check / --calibrate).
+"""
+
+from dtf_tpu.plan.cost_model import Plan, PlanCost, predict, check_plan
+from dtf_tpu.plan.mesh_spec import MeshSpec, mesh_spec
+from dtf_tpu.plan.model_stats import ModelStats, characterize
+from dtf_tpu.plan.search import search, ranked_artifact
+from dtf_tpu.plan.compile import (apply_plan, load_plan_file,
+                                  plan_from_config, resolve_plan)
+
+__all__ = [
+    "Plan", "PlanCost", "predict", "check_plan",
+    "MeshSpec", "mesh_spec",
+    "ModelStats", "characterize",
+    "search", "ranked_artifact",
+    "apply_plan", "load_plan_file", "plan_from_config", "resolve_plan",
+]

@@ -1,0 +1,216 @@
+"""Plan → config compilation: resolve ``--plan auto|<file>`` into the
+EXISTING parallelism flags.
+
+The planner deliberately owns no runtime of its own — a chosen
+:class:`Plan` compiles down to exactly the flags an operator would have
+typed (`--model_parallelism`, `--seq_parallelism`,
+`--optimizer_sharding`, `--grad_accum_steps` / `--num_microbatches`,
+`--remat`, `--num_devices`), so a plan-selected run is bit-identical to
+the same configuration set by hand (tests/test_plan.py asserts this).
+The flags a plan owns must be at their defaults when ``--plan`` is
+given: a hand-set `--model_parallelism 4` silently overridden by a plan
+(or vice versa) is exactly the folklore-vs-model ambiguity this
+subsystem exists to remove.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from dtf_tpu.plan.cost_model import Plan, check_plan, predict
+from dtf_tpu.plan.mesh_spec import MeshSpec, mesh_spec
+from dtf_tpu.plan.model_stats import ModelStats, characterize
+from dtf_tpu.plan.search import best_plan
+
+log = logging.getLogger("dtf_tpu")
+
+# Flags a plan compiles into, with the defaults they must still hold
+# when --plan is given (conflict = loud error, never silent override)
+PLAN_OWNED_FLAGS = {
+    "model_parallelism": 1,
+    "seq_parallelism": 1,
+    "optimizer_sharding": False,
+    "grad_accum_steps": 1,
+    "num_microbatches": None,
+    "remat": False,
+    "remat_policy": None,
+}
+
+
+def dtype_bytes_of(cfg) -> int:
+    return 2 if cfg.dtype in ("bf16", "bfloat16", "fp16", "float16") else 4
+
+
+def stats_for_config(cfg) -> ModelStats:
+    """Characterize the model a config would build, at the config's
+    shapes (seq_len override, num_classes override, compute dtype)."""
+    from dtf_tpu.data import get_dataset_spec
+
+    model_name = "trivial" if cfg.use_trivial_model else cfg.model
+    seq_len = None
+    if cfg.dataset:
+        spec = get_dataset_spec(cfg.dataset)
+        if spec.is_sequence:
+            seq_len = cfg.seq_len or spec.seq_len
+    return characterize(model_name, seq_len=seq_len,
+                        num_classes=cfg.num_classes,
+                        dtype_bytes=dtype_bytes_of(cfg))
+
+
+def apply_plan(cfg, plan: Plan):
+    """Compile a plan into config flags.  Raises when a plan-owned flag
+    was hand-set (ambiguous intent) or when an explicit --num_devices
+    contradicts the plan's device count.  The returned config has
+    ``plan=""`` — it IS the hand-flag form."""
+    conflicts = [k for k, default in PLAN_OWNED_FLAGS.items()
+                 if getattr(cfg, k) != default]
+    if conflicts:
+        raise ValueError(
+            f"--plan conflicts with hand-set flags {conflicts}: a plan "
+            f"compiles into exactly these flags — drop them or drop "
+            f"--plan")
+    if cfg.num_devices is not None and cfg.num_devices != plan.num_devices:
+        raise ValueError(
+            f"--num_devices {cfg.num_devices} contradicts the plan's "
+            f"{plan.num_devices} devices ({plan.describe()})")
+    is_pipeline = cfg.model.startswith("pipeline_transformer")
+    kw = dict(
+        plan="",
+        num_devices=plan.num_devices,
+        model_parallelism=plan.model_axis_size,
+        seq_parallelism=plan.seq,
+        optimizer_sharding=bool(plan.zero),
+        remat=plan.remat,
+    )
+    if is_pipeline:
+        kw["num_microbatches"] = plan.microbatch
+    elif plan.microbatch > 1:
+        kw["grad_accum_steps"] = plan.microbatch
+    return cfg.replace(**kw)
+
+
+def plan_from_config(cfg, num_devices: int) -> Plan:
+    """The plan a hand-flagged config already describes (the inverse of
+    apply_plan) — what the calibration loop predicts for a run
+    configured without --plan.
+
+    Two deliberate approximations: a pipeline config with
+    ``num_microbatches`` unset mirrors the runner's auto-pick
+    (M = 4·pp halved until it divides the per-shard batch —
+    cli/runner.py), and ``--remat_policy dots`` maps to plain
+    ``remat=True`` (the cost model has no selective-remat point; it
+    over-counts dots' recompute and under-counts its saved bytes)."""
+    maxis = max(cfg.model_parallelism, 1)
+    is_pipeline = cfg.model.startswith("pipeline_transformer")
+    sp = max(cfg.seq_parallelism, 1)
+    if num_devices % (maxis * sp):
+        raise ValueError(
+            f"{num_devices} devices not divisible by "
+            f"model_parallelism×seq_parallelism = {maxis * sp}")
+    if is_pipeline and cfg.num_microbatches is None:
+        per_shard = cfg.batch_size // max(num_devices // (maxis * sp), 1)
+        micro = 4 * maxis
+        while micro > 1 and per_shard % micro:
+            micro //= 2
+    else:
+        micro = (cfg.num_microbatches if is_pipeline
+                 else cfg.grad_accum_steps) or 1
+    return Plan(data=num_devices // (maxis * sp),
+                model=1 if is_pipeline else maxis,
+                pipeline=maxis if is_pipeline else 1,
+                seq=sp, zero=int(bool(cfg.optimizer_sharding)),
+                microbatch=max(int(micro), 1),
+                remat=bool(cfg.remat or cfg.remat_policy))
+
+
+def load_plan_file(path: str) -> Plan:
+    """A plan from a JSON file: a bare plan object, a ``{"plan": …}``
+    wrapper, or a ranked artifact (``{"plans": [...]}`` — the first
+    feasible entry wins)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "plans" in doc:
+        for entry in doc["plans"]:
+            if entry.get("feasible", True):
+                return Plan.from_dict(entry["plan"])
+        raise ValueError(f"ranked plan artifact {path!r} contains no "
+                         f"feasible plan")
+    if isinstance(doc, dict) and "plan" in doc:
+        doc = doc["plan"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"plan file {path!r}: expected a JSON object, "
+                         f"got {type(doc).__name__}")
+    return Plan.from_dict(doc)
+
+
+def resolve_plan(cfg, mesh: Optional[MeshSpec] = None):
+    """Resolve ``cfg.plan`` ("auto" or a plan-file path) into concrete
+    config flags.  No-op when the flag is empty.  Infeasible or invalid
+    plans are rejected loudly — a plan that would OOM must die here,
+    not twenty minutes into compilation on a pod."""
+    if not cfg.plan:
+        return cfg
+    if cfg.distribution_strategy in ("horovod", "parameter_server"):
+        raise ValueError(
+            f"--plan targets the SPMD strategies (batch_size is the "
+            f"global batch); --distribution_strategy "
+            f"{cfg.distribution_strategy} scales batch per replica — "
+            f"set the parallelism flags by hand")
+    stats = stats_for_config(cfg)
+    # an explicit --num_devices bounds the LIVE mesh (planning a subset
+    # of the attached chips); explicit presets/descriptors ignore it —
+    # apply_plan's contradiction check still fires for those
+    mesh = mesh or mesh_spec(cfg.plan_mesh, live_devices=cfg.num_devices)
+    if not cfg.plan_mesh and cfg.num_devices is not None \
+            and mesh.num_hosts > 1:
+        raise ValueError(
+            "--plan with --num_devices on a multi-host run is ambiguous "
+            "(num_devices means per-process local chips under mirrored, "
+            "a global truncation otherwise) — pass an explicit "
+            "--plan_mesh descriptor instead")
+    if cfg.plan == "auto":
+        ranked = best_plan(stats, mesh, cfg.batch_size,
+                           optimizer=cfg.optimizer)
+        plan, cost = ranked.plan, ranked.cost
+        log.info(
+            "plan auto (%s, %d devices): %s — predicted %.1f ms/step, "
+            "peak %.2f GiB/device (budget %.2f)", mesh.name,
+            mesh.num_devices, plan.describe(), cost.step_time_s * 1e3,
+            cost.peak_bytes / 2 ** 30, cost.hbm_budget_bytes / 2 ** 30)
+    else:
+        plan = load_plan_file(cfg.plan)
+        violations = check_plan(plan, stats, mesh, cfg.batch_size)
+        if violations:
+            raise ValueError(
+                f"plan {plan.describe()} from {cfg.plan!r} is invalid "
+                f"for {stats.model} on {mesh.name}: "
+                f"{'; '.join(violations)}")
+        cost = predict(plan, stats, mesh, cfg.batch_size,
+                       optimizer=cfg.optimizer)
+        if not cost.feasible:
+            raise ValueError(
+                f"plan {plan.describe()} from {cfg.plan!r} is "
+                f"memory-INFEASIBLE on {mesh.name}: predicted peak "
+                f"{cost.peak_bytes / 2**30:.2f} GiB/device exceeds the "
+                f"budget {cost.hbm_budget_bytes / 2**30:.2f} GiB "
+                f"({mesh.hbm_bytes / 2**30:.0f} GiB HBM × "
+                f"{cost.hbm_budget_bytes / mesh.hbm_bytes:.0%})")
+        log.info(
+            "plan %s from %s: predicted %.1f ms/step, peak %.2f "
+            "GiB/device", plan.describe(), cfg.plan,
+            cost.step_time_s * 1e3, cost.peak_bytes / 2 ** 30)
+    import jax
+    attached = jax.device_count()
+    if plan.num_devices > attached:
+        # without this, runtime/mesh.initialize silently truncates the
+        # device list and the run executes a DIFFERENT parallelization
+        # than the one planned (e.g. a 4x4-pod plan degrading to dp=2
+        # on an 8-device box) — the opposite of "plans die loudly"
+        raise ValueError(
+            f"plan {plan.describe()} targets {plan.num_devices} devices "
+            f"({mesh.name} mesh) but only {attached} are attached — a "
+            f"plan for a larger simulated mesh can be ranked with "
+            f"plan_main, not run here")
+    return apply_plan(cfg, plan)

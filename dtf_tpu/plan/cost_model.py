@@ -1,0 +1,342 @@
+"""Analytic cost + memory model over the parallelism strategy space.
+
+Predicted step time and predicted peak per-device memory as PURE
+FUNCTIONS of a :class:`Plan` (the point in the strategy lattice), a
+:class:`ModelStats` (the workload) and a :class:`MeshSpec` (the
+hardware) — the AMP/DistIR idea (arXiv:2210.07297, arXiv:2111.05426):
+rank the lattice analytically, touch the accelerators only to run the
+winner.
+
+Step-time model (no-overlap, i.e. conservative: real runs overlap ring
+hops with compute):
+
+    t_step = t_compute · bubble(pipeline) + Σ t_collective
+
+    t_compute    = 3 · F_fwd (+ remat refwd) · B_global / D / flops_dev
+                   (backward ≈ 2× forward MACs)
+    grad sync    = ring allreduce over 'data' (and 'seq'):
+                   2·(n-1)/n · grad_bytes_local / bw(axis); ZeRO-1's
+                   reduce-scatter + all-gather moves the SAME volume
+                   (its win is memory + update FLOPs, not wire bytes)
+    TP psums     = 4 per block (2 fwd + 2 bwd) of the [B_loc, S_loc, d]
+                   residual stream over 'model'
+    seq ring     = (sp-1) K/V neighbor hops per block (ring attention)
+    pipeline     = bubble factor (M + pp - 1)/M on compute, plus the
+                   microbatch boundary ppermute traffic over 'model'
+
+Memory model (per device, bytes):
+
+    params (f32) / TP·PP sharding
+  + gradients (f32, same sharding; ×2 under grad accumulation — the
+    scan carry holds the accumulator while a chunk's grads materialize)
+  + optimizer state (slots × params; ÷ data-parallel ways under ZeRO-1)
+  + BN running stats
+  + activations of ONE microbatch (÷ seq ways; the TP-shardable
+    portion ÷ model ways; remat keeps only block inputs + one live
+    block's working set; a pipeline stage stashes every in-flight
+    microbatch's boundary activation)
+  + a fixed runtime overhead (compiled executables, collective
+    scratch) — FIXED_OVERHEAD_BYTES, deliberately small so the model
+    under-promises on tiny smoke configs rather than hiding headroom
+    on real ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from dtf_tpu.plan.mesh_spec import MeshSpec, MiB
+from dtf_tpu.plan.model_stats import ModelStats
+
+# Optimizer-state slots per parameter (train/optimizer.py: keras_sgd
+# keeps one velocity; adamw keeps mu+nu)
+OPTIMIZER_SLOTS = {"sgd": 1, "momentum": 1, "adamw": 2}
+
+# Fraction of HBM a plan may claim: XLA needs headroom for collective
+# scratch and fusion temporaries beyond the model's own live set
+HBM_FRACTION = 0.9
+
+FIXED_OVERHEAD_BYTES = 64 * MiB
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One point in the strategy lattice.
+
+    ``model`` (tensor-parallel ways) and ``pipeline`` (GPipe stages)
+    both ride the runtime's 'model' mesh axis, so at most one of them
+    may exceed 1; ``microbatch`` is sequential gradient-accumulation
+    chunks for the dense families and the GPipe microbatch count for
+    the pipeline family; ``zero`` is the ZeRO stage (this repo
+    implements stage 1, --optimizer_sharding)."""
+
+    data: int = 1
+    model: int = 1
+    seq: int = 1
+    pipeline: int = 1
+    zero: int = 0
+    microbatch: int = 1
+    remat: bool = False
+
+    def __post_init__(self):
+        for f in ("data", "model", "seq", "pipeline", "microbatch"):
+            if getattr(self, f) < 1:
+                raise ValueError(f"plan.{f} must be >= 1, got "
+                                 f"{getattr(self, f)}")
+        if self.zero not in (0, 1):
+            raise ValueError(f"plan.zero must be 0 or 1 (this repo "
+                             f"implements ZeRO-1), got {self.zero}")
+        if self.model > 1 and self.pipeline > 1:
+            raise ValueError(
+                "plan.model and plan.pipeline both ride the 'model' mesh "
+                "axis — at most one may exceed 1")
+
+    @property
+    def model_axis_size(self) -> int:
+        """Size of the runtime's 'model' mesh axis (tensor ways or
+        pipeline stages — one of the two is 1)."""
+        return self.model * self.pipeline
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.seq * self.model_axis_size
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Plan":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown plan fields {sorted(unknown)}; "
+                             f"have {sorted(known)}")
+        return cls(**d)
+
+    def describe(self) -> str:
+        parts = [f"dp={self.data}"]
+        if self.model > 1:
+            parts.append(f"tp={self.model}")
+        if self.seq > 1:
+            parts.append(f"sp={self.seq}")
+        if self.pipeline > 1:
+            parts.append(f"pp={self.pipeline}")
+        if self.zero:
+            parts.append(f"zero{self.zero}")
+        if self.microbatch > 1:
+            parts.append(f"micro={self.microbatch}")
+        if self.remat:
+            parts.append("remat")
+        return "×".join(parts[:1]) + ("," + ",".join(parts[1:])
+                                      if parts[1:] else "")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanCost:
+    """Prediction for one plan: seconds per step, peak per-device
+    bytes, feasibility against the HBM budget, and the breakdown the
+    CLI prints."""
+
+    step_time_s: float
+    peak_bytes: int
+    hbm_budget_bytes: int
+    compute_s: float
+    comm_s: float
+    breakdown: Dict[str, float]
+
+    @property
+    def feasible(self) -> bool:
+        return self.peak_bytes <= self.hbm_budget_bytes
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["feasible"] = self.feasible
+        return d
+
+
+def check_plan(plan: Plan, stats: ModelStats, mesh: MeshSpec,
+               global_batch: int) -> List[str]:
+    """Hard-constraint violations of a plan for this workload/mesh —
+    divisibility and capability rules mirroring what cli/runner.py and
+    train/loop.py enforce at run construction.  Empty list = the plan
+    compiles (memory feasibility is predict()'s separate verdict)."""
+    v: List[str] = []
+    if plan.num_devices != mesh.num_devices:
+        v.append(f"plan uses {plan.num_devices} devices, mesh has "
+                 f"{mesh.num_devices}")
+    if plan.model > 1:
+        if not stats.supports_tp:
+            v.append(f"{stats.model}: tensor parallelism needs the plain "
+                     f"transformer family")
+        else:
+            if stats.num_heads % plan.model:
+                v.append(f"num_heads {stats.num_heads} % tp {plan.model}")
+            if stats.d_ff % plan.model:
+                v.append(f"d_ff {stats.d_ff} % tp {plan.model}")
+    if plan.seq > 1:
+        if not stats.supports_seq:
+            v.append(f"{stats.model}: sequence parallelism needs the "
+                     f"transformer family on token data")
+        elif stats.seq_len % plan.seq:
+            v.append(f"seq_len {stats.seq_len} % sp {plan.seq}")
+    if plan.pipeline > 1:
+        if not stats.supports_pipeline:
+            v.append(f"{stats.model}: pipeline stages need the "
+                     f"pipeline_transformer family")
+        elif stats.num_layers % plan.pipeline:
+            v.append(f"num_layers {stats.num_layers} % pp {plan.pipeline}")
+    if plan.remat and not stats.supports_remat:
+        v.append(f"{stats.model}: no remat policy for this family")
+    if global_batch % plan.data:
+        v.append(f"global batch {global_batch} % dp {plan.data}")
+    else:
+        per_replica = global_batch // plan.data
+        if per_replica % plan.microbatch:
+            v.append(f"per-replica batch {per_replica} % microbatch "
+                     f"{plan.microbatch}")
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Prediction
+# ---------------------------------------------------------------------------
+
+def _axis_bw(mesh: MeshSpec, plan: Plan, axis: str) -> float:
+    """Ring bandwidth for one mesh axis under the runtime's row-major
+    ('data','seq','model') layout: 'model' is innermost (stride 1),
+    'seq' strides over it, 'data' is outermost."""
+    m = plan.model_axis_size
+    stride, size = {
+        "model": (1, m),
+        "seq": (m, plan.seq),
+        "data": (m * plan.seq, plan.data),
+    }[axis]
+    return mesh.axis_bandwidth(stride, size)
+
+
+def _ring_s(bytes_: float, ways: int, bw: float) -> float:
+    """Ring allreduce wall time: 2·(n-1)/n of the buffer crosses each
+    device's link (reduce-scatter + all-gather halves)."""
+    if ways <= 1 or bytes_ <= 0:
+        return 0.0
+    return 2.0 * (ways - 1) / ways * bytes_ / bw
+
+
+def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
+            global_batch: int, optimizer: str = "sgd",
+            hbm_fraction: float = HBM_FRACTION,
+            device_flops: Optional[float] = None) -> PlanCost:
+    """Predicted (step time, peak memory) for a valid plan.
+
+    ``device_flops`` overrides the mesh's achievable-FLOP/s estimate —
+    the calibration loop passes the measured probe here.  Call
+    :func:`check_plan` first; predicting an invalid plan still returns
+    numbers, they just describe a run the framework would refuse."""
+    flops_dev = device_flops or mesh.device_flops
+    slots = OPTIMIZER_SLOTS.get(optimizer)
+    if slots is None:
+        raise ValueError(f"unknown optimizer {optimizer!r}; have "
+                         f"{sorted(OPTIMIZER_SLOTS)}")
+    mp, pp, sp, dp = plan.model, plan.pipeline, plan.seq, plan.data
+    micro_examples = max(global_batch // (dp * plan.microbatch), 1)
+
+    # ---- parameters / gradients / optimizer state (f32) --------------
+    param_local = 0.0
+    fwd_flops = 0.0       # per example, whole model
+    remat_refwd = 0.0     # extra forward FLOPs per example under remat
+    act_local = 0.0       # per-device activation bytes, one microbatch
+    max_block_act = 0.0   # live working set of the block being remat'd
+    boundary_bytes = stats.seq_len * stats.d_model * stats.dtype_bytes \
+        if stats.seq_len else 0
+    for layer in stats.layers:
+        p = float(layer.params)
+        if layer.tp and mp > 1:
+            p /= mp
+        if layer.stage and pp > 1:
+            p /= pp
+        param_local += p
+        fwd_flops += layer.flops
+        la = float(layer.act_bytes)
+        if mp > 1 and layer.act_tp_bytes:
+            la -= layer.act_tp_bytes * (1.0 - 1.0 / mp)
+        if plan.remat and layer.stage:
+            remat_refwd += layer.flops
+            la = float(layer.remat_act_bytes)
+        if layer.stage and pp > 1:
+            # this stage holds 1/pp of the stacked blocks...
+            la /= pp
+        la /= max(sp, 1)
+        act_local += la
+        if layer.stage:
+            max_block_act = max(max_block_act,
+                                float(layer.act_bytes) / max(sp, 1))
+    param_bytes = param_local * 4
+    grad_bytes = param_bytes * (2 if plan.microbatch > 1 else 1)
+    opt_bytes = slots * param_bytes / (dp if plan.zero else 1)
+    state_bytes = stats.state * 4
+
+    act_bytes = act_local * micro_examples
+    if plan.remat:
+        # one block's full working set is live while it recomputes
+        act_bytes += max_block_act * micro_examples
+    if pp > 1:
+        # GPipe stashes every in-flight microbatch's stage-boundary
+        # activation for the backward pass
+        act_bytes += (plan.microbatch * micro_examples
+                      * boundary_bytes / max(sp, 1))
+
+    peak = int(param_bytes + grad_bytes + opt_bytes + state_bytes
+               + act_bytes + FIXED_OVERHEAD_BYTES)
+    budget = int(mesh.hbm_bytes * hbm_fraction)
+
+    # ---- compute ------------------------------------------------------
+    # fwd + backward(≈2× MACs) + remat re-forward, ideal scaling over
+    # every mesh axis (TP/SP/PP all divide the per-example work)
+    flops_step = (3.0 * fwd_flops
+                  + (remat_refwd if plan.remat else 0.0)) * global_batch
+    compute_s = flops_step / plan.num_devices / flops_dev
+    bubble = ((plan.microbatch + pp - 1) / plan.microbatch if pp > 1
+              else 1.0)
+    compute_s *= bubble
+
+    # ---- collectives --------------------------------------------------
+    breakdown: Dict[str, float] = {}
+    t_grad = _ring_s(param_local * 4, dp, _axis_bw(mesh, plan, "data"))
+    t_grad += _ring_s(param_local * 4, sp, _axis_bw(mesh, plan, "seq"))
+    breakdown["grad_sync_s"] = t_grad
+
+    t_tp = 0.0
+    if mp > 1:
+        stream = (global_batch // dp) * (stats.seq_len / max(sp, 1)) \
+            * stats.d_model * stats.dtype_bytes
+        n_blocks = sum(1 for l in stats.layers if l.stage)
+        t_tp = _ring_s(4.0 * n_blocks * stream, mp,
+                       _axis_bw(mesh, plan, "model"))
+    breakdown["tp_psum_s"] = t_tp
+
+    t_ring = 0.0
+    if sp > 1:
+        # ring attention: (sp-1) neighbor hops of the local K+V per
+        # block, forward and backward
+        n_blocks = sum(1 for l in stats.layers if l.stage)
+        kv_local = 2.0 * (stats.seq_len / sp) * stats.d_model \
+            * stats.dtype_bytes * (global_batch // dp)
+        t_ring = (2.0 * n_blocks * (sp - 1) * kv_local
+                  / _axis_bw(mesh, plan, "seq"))
+    breakdown["seq_ring_s"] = t_ring
+
+    t_pipe = 0.0
+    if pp > 1:
+        t_pipe = (2.0 * plan.microbatch * micro_examples * boundary_bytes
+                  / _axis_bw(mesh, plan, "model"))
+    breakdown["pipeline_xfer_s"] = t_pipe
+
+    comm_s = t_grad + t_tp + t_ring + t_pipe
+    breakdown.update(
+        compute_s=compute_s, bubble_factor=bubble,
+        param_bytes=param_bytes, grad_bytes=grad_bytes,
+        opt_bytes=opt_bytes, act_bytes=act_bytes)
+    return PlanCost(step_time_s=compute_s + comm_s, peak_bytes=peak,
+                    hbm_budget_bytes=budget, compute_s=compute_s,
+                    comm_s=comm_s, breakdown=breakdown)

@@ -188,6 +188,21 @@ def initialize(cfg: Config) -> MeshRuntime:
     return MeshRuntime(mesh=mesh, strategy=strategy)
 
 
+def topology() -> dict:
+    """The live process/device topology as the parallelism planner's
+    mesh descriptor sees it: hosts (= processes), local devices per
+    host, and the backend platform.  Read-only, but the device query
+    initializes the jax backend — in a multi-process run call
+    :func:`_maybe_init_distributed` first (runner._run does), or
+    ``process_count()`` reports 1 and the later distributed
+    rendezvous refuses an already-initialized backend."""
+    return {
+        "num_hosts": jax.process_count(),
+        "devices_per_host": jax.local_device_count(),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def make_mesh(devices: Optional[Sequence] = None, data: int = -1,
               seq: int = 1, model: int = 1) -> Mesh:
     """Direct mesh constructor for tests and advanced use."""

@@ -277,6 +277,64 @@ class Checkpointer:
                   self.directory)
         return None
 
+    def gc(self, keep: int) -> List[int]:
+        """Cross-run GC by VERIFIED-set: delete every step except the
+        newest ``keep`` sha256-verified ones.  Orbax's ``max_to_keep``
+        only prunes within one run; a long resume chain accumulates
+        every previous run's checkpoints in the same model_dir — this
+        is the lever that bounds them (opt-in: ``--checkpoint_keep``).
+
+        Safety rules (the reason this is by verified-set, not by age):
+          - steps NEWER than the newest verified step are never deleted
+            — an unverified newest may be another process's in-flight
+            save, and a newest-only-unverified state must keep its
+            fallback chain intact;
+          - with NO verified step at all, nothing is deleted (GC must
+            never convert "all unverified" into "nothing left");
+          - deletion enumerates the directory directly (not the orbax
+            manager's cached view), so previous runs' steps are seen.
+
+        Returns the deleted step numbers."""
+        if keep <= 0:
+            return []
+        try:
+            steps = sorted(int(name) for name in os.listdir(self.directory)
+                           if name.isdigit()
+                           and os.path.isdir(os.path.join(self.directory,
+                                                          name)))
+        except OSError:
+            return []
+        # newest-first, stopping after `keep` verified steps: every
+        # step older than the newest verified one is deleted unless it
+        # is in the keep-set, so re-hashing the long doomed tail (a
+        # resume chain's worth of multi-GB payloads) changes nothing
+        verified: List[int] = []
+        for s in reversed(steps):
+            if self.verify(s) == "ok":
+                verified.append(s)
+                if len(verified) == keep:
+                    break
+        if not verified:
+            log.warning("checkpoint gc: no sha256-verified step under "
+                        "%s — nothing deleted", self.directory)
+            return []
+        keep_set = set(verified)
+        newest_verified = verified[0]
+        doomed = [s for s in steps
+                  if s not in keep_set and s < newest_verified]
+        import shutil
+        for s in doomed:
+            shutil.rmtree(os.path.join(self.directory, str(s)),
+                          ignore_errors=True)
+            try:
+                os.unlink(manifest_path(self.directory, s))
+            except OSError:
+                pass
+        if doomed:
+            log.info("checkpoint gc: kept %d verified step(s) %s, "
+                     "deleted %s", len(keep_set), sorted(keep_set), doomed)
+        return doomed
+
     def wait(self) -> None:
         """Block until in-flight saves land, then seal them with
         manifests (and drop manifests orphaned by max_to_keep pruning).
@@ -435,13 +493,17 @@ class CheckpointCallback:
           checkpoint is durable before the process exits EXIT_PREEMPTED
       host_state_fn(step) — host-side resume payload (data position,
           seed) carried in each save's manifest
+      keep         — cross-run GC budget (--checkpoint_keep): after the
+          final wait() seals everything, delete all but the newest
+          `keep` verified steps (Checkpointer.gc safety rules apply)
     """
 
     def __init__(self, model_dir: str, max_to_keep: int = 3,
-                 every_steps: int = 0, host_state_fn=None):
+                 every_steps: int = 0, host_state_fn=None, keep: int = 0):
         self.ckpt = Checkpointer(model_dir, max_to_keep=max_to_keep)
         self.every_steps = int(every_steps or 0)
         self.host_state_fn = host_state_fn
+        self.keep = int(keep or 0)
 
     def _host(self, step: int) -> Optional[dict]:
         if self.host_state_fn is None:
@@ -483,3 +545,8 @@ class CheckpointCallback:
 
     def on_train_end(self, logs=None):
         self.ckpt.wait()
+        if self.keep and jax.process_index() == 0:
+            # after wait(): this run's saves are sealed (verified), so
+            # they anchor the verified-set the GC keeps; rank-0-only —
+            # deletion is not a collective
+            self.ckpt.gc(self.keep)

@@ -812,6 +812,11 @@ class Trainer:
             interval_s=getattr(cfg, "heartbeat_secs", 5.0))
         compile_pending = True
         window_t0 = time.monotonic()
+        # calibration hook (dtf_tpu/plan): clean per-step wall times —
+        # one sample per unskewed log window, so compile and epoch-
+        # boundary work never contaminate the measurement the planner's
+        # predicted-vs-measured ratio is computed against
+        window_step_s: list = []
         # a skewed window covers non-step time (first-compile, or an
         # epoch boundary's eval/checkpoint) or fewer than log_steps
         # steps (post-boundary partial): emitting it would misreport
@@ -902,6 +907,7 @@ class Trainer:
                                 "log_window", window_s, step=global_step,
                                 steps=cfg.log_steps,
                                 step_s=window_s / cfg.log_steps)
+                            window_step_s.append(window_s / cfg.log_steps)
                             if step_guard is not None:
                                 step_guard.observe(global_step, window_s)
                         window_t0 = now
@@ -1016,6 +1022,35 @@ class Trainer:
         trace.event("train_end", step=global_step,
                     wall_s=time.time() - t0)
         trace.flush()
+        # calibration gauges (dtf_tpu/plan reads these after a measured
+        # smoke): the median clean-window step time, and the live
+        # device bytes at train end — params + optimizer state + grads
+        # + pipeline buffers, the persistent portion of the planner's
+        # predicted peak.  One live_arrays walk per fit: negligible.
+        from dtf_tpu.obs.registry import default_registry
+        if window_step_s:
+            mid = sorted(window_step_s)[len(window_step_s) // 2]
+            default_registry().gauge("train_step_s", unit="s").set(mid)
+        try:
+            # PER-DEVICE bytes (the planner's predicted peak is
+            # per-device): sum physical shard bytes on the local
+            # devices, averaged over them — a.size alone counts the
+            # global logical array, which overstates sharded tensors
+            # by the shard count and misstates replicated ones
+            live = 0
+            for a in jax.live_arrays():
+                shards = getattr(a, "addressable_shards", None)
+                if shards:
+                    live += sum(int(np.prod(s.data.shape))
+                                * a.dtype.itemsize for s in shards)
+                else:
+                    live += a.size * a.dtype.itemsize
+            live //= max(jax.local_device_count(), 1)
+        except Exception:  # noqa: BLE001 — diagnostics must not fail a run
+            live = 0
+        if live:
+            default_registry().gauge("train_live_bytes",
+                                     unit="bytes").set(live)
         stats = build_stats(history, eval_output, time_cb)
         return state, stats
 

@@ -204,3 +204,85 @@ def test_tensorboard_e2e(tmp_path):
     assert files, "no event file written"
     payload = b"".join(records.read_tfrecord_file(str(train_dir / files[0])))
     assert b"epoch_loss" in payload
+
+
+# ---------------------------------------------------------------------------
+# cross-run GC by verified-set (--checkpoint_keep)
+# ---------------------------------------------------------------------------
+
+from dtf_tpu.train.checkpoint import CheckpointCallback, manifest_path
+
+
+def _sealed_steps(tmp_path, steps, name="gc"):
+    """A Checkpointer with sha256-sealed saves at the given steps."""
+    ckpt = Checkpointer(str(tmp_path / name), max_to_keep=50)
+    for s in steps:
+        ckpt.save({"w": np.full((4,), float(s), np.float32)}, step=s)
+    ckpt.wait()
+    return ckpt
+
+
+def _dirs(ckpt):
+    return sorted(int(n) for n in os.listdir(ckpt.directory)
+                  if n.isdigit())
+
+
+def test_gc_keeps_newest_verified(tmp_path):
+    ckpt = _sealed_steps(tmp_path, [1, 2, 3, 4, 5])
+    assert ckpt.gc(keep=2) == [1, 2, 3]
+    assert _dirs(ckpt) == [4, 5]
+    assert ckpt.verify(4) == "ok" and ckpt.verify(5) == "ok"
+    # the deleted steps' manifests went with them
+    for s in (1, 2, 3):
+        assert not os.path.exists(manifest_path(ckpt.directory, s))
+    ckpt.close()
+
+
+def test_gc_never_deletes_newer_than_newest_verified(tmp_path):
+    """An unverified step NEWER than the newest verified one may be
+    another process's in-flight save — GC must neither count it toward
+    `keep` nor delete it."""
+    ckpt = _sealed_steps(tmp_path, [1, 2, 3])
+    os.makedirs(os.path.join(ckpt.directory, "9"))  # in-flight, no manifest
+    assert ckpt.gc(keep=1) == [1, 2]
+    assert _dirs(ckpt) == [3, 9]
+    ckpt.close()
+
+
+def test_gc_all_unverified_deletes_nothing(tmp_path):
+    """GC must never convert 'all unverified' into 'nothing left'."""
+    ckpt = Checkpointer(str(tmp_path / "u"), max_to_keep=50)
+    for s in (1, 2, 3):
+        os.makedirs(os.path.join(ckpt.directory, str(s)))
+    assert ckpt.gc(keep=1) == []
+    assert _dirs(ckpt) == [1, 2, 3]
+    ckpt.close()
+
+
+def test_gc_disabled_and_validated(tmp_path):
+    ckpt = _sealed_steps(tmp_path, [1, 2])
+    assert ckpt.gc(keep=0) == []
+    assert _dirs(ckpt) == [1, 2]
+    ckpt.close()
+    with pytest.raises(ValueError, match="checkpoint_keep"):
+        Config(model="resnet20", dataset="cifar10", checkpoint_keep=-1)
+
+
+def test_gc_spans_previous_runs_via_callback(tmp_path):
+    """The --checkpoint_keep wiring: a resume chain's earlier-run
+    checkpoints live in the same model_dir; the callback's final GC
+    (on_train_end, after wait() seals this run's saves) prunes them
+    down to the newest `keep` verified."""
+    # "previous run": three sealed steps
+    prev = CheckpointCallback(str(tmp_path), max_to_keep=50, keep=0)
+    for s in (1, 2, 3):
+        prev.ckpt.save({"w": np.zeros((2,), np.float32)}, step=s)
+    prev.on_train_end()
+    prev.ckpt.close()
+    # "this run": two more, with the GC budget armed
+    cb = CheckpointCallback(str(tmp_path), max_to_keep=50, keep=2)
+    for s in (4, 5):
+        cb.ckpt.save({"w": np.zeros((2,), np.float32)}, step=s)
+    cb.on_train_end()  # wait -> seal -> gc(2)
+    assert _dirs(cb.ckpt) == [4, 5]
+    cb.ckpt.close()

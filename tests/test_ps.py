@@ -8,6 +8,7 @@ store when built, and the protocol-compatible Python fallback either
 way.
 """
 
+import os
 import threading
 import time
 
@@ -818,4 +819,170 @@ def test_reseed_still_allowed_in_early_window():
         assert ver >= 1
     finally:
         client.close()
+        srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# restart-generation tag (whole-job supervisor restart vs PS-only crash)
+# ---------------------------------------------------------------------------
+
+def test_generation_helpers(tmp_path, monkeypatch):
+    """current_generation parses the supervisor env (garbage -> 0);
+    the sidecar round-trips and is absent-tolerant."""
+    monkeypatch.delenv(ps_lib.GENERATION_ENV, raising=False)
+    assert ps_lib.current_generation() == 0
+    monkeypatch.setenv(ps_lib.GENERATION_ENV, "3")
+    assert ps_lib.current_generation() == 3
+    monkeypatch.setenv(ps_lib.GENERATION_ENV, "junk")
+    assert ps_lib.current_generation() == 0
+    snap = str(tmp_path / "s.snap")
+    assert ps_lib.read_snapshot_generation(snap) == 0  # no sidecar
+    ps_lib.write_snapshot_generation(snap, 2)
+    assert ps_lib.read_snapshot_generation(snap) == 2
+
+
+def test_snapshot_sidecar_written_before_snapshot(tmp_path, monkeypatch):
+    """The generation sidecar lands BEFORE the snapshot dump: a crash
+    between the two writes leaves the snapshot claimed by a NEWER
+    sidecar (safe — any stale-generation footer was already stripped in
+    place at this loop's restore), never a fresh snapshot under an OLD
+    sidecar, which a same-generation restore would wrongly strip."""
+    monkeypatch.setenv(ps_lib.GENERATION_ENV, "2")
+    srv = ps_lib.PsServer(port=0)
+    loop = ps_lib._SnapshotLoop(srv, str(tmp_path / "snaps"),
+                                interval=3600)
+    try:
+        assert loop._snap() == "uninit"  # store not initialized yet...
+        # ...but the generation claim already landed
+        assert ps_lib.read_snapshot_generation(loop.path) == 2
+        assert not os.path.exists(loop.path)
+    finally:
+        loop.stop()
+        srv.stop()
+
+
+def test_generation_env_parity_with_launcher():
+    """launch.py duplicates the GENERATION_ENV string (stdlib-only, no
+    dtf_tpu import in the supervisor) — this is the pin: build_env must
+    export exactly the variable the PS snapshot loop reads."""
+    from dtf_tpu.cli.launch import build_env
+    env = build_env(0, 1, "127.0.0.1:1234", generation=7)
+    assert env[ps_lib.GENERATION_ENV] == "7"
+
+
+def _snapshot_with_done(server, path):
+    """A snapshot whose done_count footer records one finished worker."""
+    client = ps_lib.PsClient(f"127.0.0.1:{server.port}")
+    client.init(np.ones(3, np.float32))
+    client.done()
+    client.close()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            server.snapshot(path)
+            return
+        except ValueError:
+            time.sleep(0.05)
+    raise AssertionError("store never became snapshotable")
+
+
+def test_strip_done_footer_file_level(server, tmp_path):
+    """strip_done_footer removes exactly the DONE footer: params/
+    version restore intact, the tally restores as zero; non-snapshot
+    and already-stripped files are refused untouched."""
+    path = str(tmp_path / "s.snap")
+    assert ps_lib.strip_done_footer(path) is False  # missing file
+    junk = str(tmp_path / "junk.snap")
+    with open(junk, "wb") as f:
+        f.write(b"not a snapshot at all")
+    assert ps_lib.strip_done_footer(junk) is False
+
+    _snapshot_with_done(server, path)
+    with_footer = os.path.getsize(path)
+    assert ps_lib.strip_done_footer(path) is True
+    assert os.path.getsize(path) == with_footer - 16
+    assert ps_lib.strip_done_footer(path) is False  # already stripped
+
+    srv2 = ps_lib.PsServer(port=0)
+    try:
+        srv2.restore(path)  # footer-less files restore with tally 0
+        c = ps_lib.PsClient(f"127.0.0.1:{srv2.port}")
+        _, flat = c.pull()
+        np.testing.assert_array_equal(flat, np.ones(3, np.float32))
+        c.close()
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (srv2.wait(1), done.set()),
+                             daemon=True)
+        t.start()
+        assert not done.wait(1.2), (
+            "stripped snapshot still carries the DONE tally")
+    finally:
+        srv2.stop()
+
+
+def test_whole_job_restart_discards_stale_done_count(tmp_path,
+                                                     monkeypatch):
+    """The PR-4 leftover, closed: a snapshot dumped under supervisor
+    attempt 0 restores under attempt 1 (DTF_RESTART_GENERATION=1) with
+    the done_count DISCARDED — wait(num_workers) must not return until
+    the re-run workers re-deliver — while params/version survive."""
+    snap_dir = str(tmp_path / "snaps")
+    monkeypatch.setenv(ps_lib.GENERATION_ENV, "0")
+    srv = ps_lib.PsServer(port=0)
+    loop = ps_lib._SnapshotLoop(srv, snap_dir, interval=3600)
+    _snapshot_with_done(srv, loop.path)
+    loop.stop()   # final dump tags the sidecar with generation 0
+    srv.stop()
+    assert ps_lib.read_snapshot_generation(loop.path) == 0
+
+    # whole-job restart: the supervisor hands every rank attempt 1
+    monkeypatch.setenv(ps_lib.GENERATION_ENV, "1")
+    srv2 = ps_lib.PsServer(port=0, defer_accept=True)
+    loop2 = ps_lib._SnapshotLoop(srv2, snap_dir, interval=3600)
+    srv2.begin_accept()
+    try:
+        c = ps_lib.PsClient(f"127.0.0.1:{srv2.port}")
+        ver, flat = c.pull()   # params + version survived the strip
+        np.testing.assert_array_equal(flat, np.ones(3, np.float32))
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (srv2.wait(1), done.set()),
+                             daemon=True)
+        t.start()
+        assert not done.wait(1.5), (
+            "stale generation's done_count double-counted: "
+            "wait(num_workers) returned before any re-run worker "
+            "delivered DONE")
+        c.done()               # the re-run worker re-delivers...
+        assert done.wait(10)   # ...and only then does wait() return
+        c.close()
+    finally:
+        loop2.stop()
+        srv2.stop()
+
+
+def test_ps_only_restart_same_generation_keeps_done_count(tmp_path,
+                                                          monkeypatch):
+    """The PR-1 durability contract is UNCHANGED by the generation tag:
+    a PS-only crash (same supervisor attempt) still restores the DONE
+    tally of workers that finished and exited for good."""
+    snap_dir = str(tmp_path / "snaps")
+    monkeypatch.setenv(ps_lib.GENERATION_ENV, "1")
+    srv = ps_lib.PsServer(port=0)
+    loop = ps_lib._SnapshotLoop(srv, snap_dir, interval=3600)
+    _snapshot_with_done(srv, loop.path)
+    loop.stop()
+    srv.stop()  # PS dies; the supervisor does NOT restart the job —
+                # the restarted PS rank is still attempt 1
+    srv2 = ps_lib.PsServer(port=0, defer_accept=True)
+    loop2 = ps_lib._SnapshotLoop(srv2, snap_dir, interval=3600)
+    srv2.begin_accept()
+    try:
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (srv2.wait(1), done.set()),
+                             daemon=True)
+        t.start()
+        assert done.wait(10), (
+            "same-generation restore lost the DONE tally")
+    finally:
+        loop2.stop()
         srv2.stop()

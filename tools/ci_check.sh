@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # One-command CI contract: tier-1 suite + test-budget audit + traced
 # smoke run + anomaly cleanliness + chaos smoke (kill → resume →
-# trajectory-exactness).
+# trajectory-exactness) + parallelism-planner contract (feasible plans
+# compile; predicted step time within tolerance of measured).
 #
 # Before this script the repo had two CONVENTIONS instead of one
 # command: "run tools/marker_audit.py after the suite" (the test-budget
@@ -27,9 +28,20 @@
 #      proves the trace contains the injected fault and nothing else.
 #      (The long kill-matrix variants live in tests/test_chaos.py,
 #      marked `slow`.)
+#   6. the parallelism-planner contract (dtf_tpu/plan):
+#      bench_plan.py reproduces the docs' ranked-plan artifact (exits
+#      nonzero if the worked example loses feasibility or ZeRO-1 stops
+#      cutting peak memory); `plan_main --check` compiles one smoke
+#      train step per top feasible-marked plan on the LM and cifar
+#      smoke configs (a cost model that blesses un-constructible plans
+#      fails HERE, not on a pod); and a calibration smoke records
+#      predicted-vs-measured step time + live bytes into the obs
+#      registry — exported to metric.log via
+#      BenchmarkFileLogger.log_registry — exiting nonzero when the
+#      ratio leaves the 2x tolerance.
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-5 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-6 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -37,18 +49,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/5]: tier-1 test suite =="
+    echo "== ci_check [1/6]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/5]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/6]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/5]: marker audit (test-budget contract) =="
+echo "== ci_check [2/6]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/5]: traced smoke run =="
+echo "== ci_check [3/6]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -56,10 +68,24 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/5]: anomaly cleanliness =="
+echo "== ci_check [4/6]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
 
-echo "== ci_check [5/5]: chaos smoke (kill -> resume -> exactness) =="
+echo "== ci_check [5/6]: chaos smoke (kill -> resume -> exactness) =="
 python tools/chaos_smoke.py
+
+echo "== ci_check [6/6]: parallelism planner (check + calibration) =="
+python bench_plan.py --out "$TRACE_DIR/PLAN_4x4.json" >/dev/null
+python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
+    --dataset lm --use_synthetic_data --seq_len 64 --batch_size 8 \
+    --check --check_top 2 --top 0 >/dev/null
+python -m dtf_tpu.cli.plan_main --devices 2 --model resnet20 \
+    --dataset cifar10 --use_synthetic_data --batch_size 8 \
+    --plan_mesh hosts=1,devices=2 --check --check_top 1 --top 0 >/dev/null
+python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
+    --use_synthetic_data --seq_len 64 --batch_size 4 --optimizer adamw \
+    --calibrate --calibrate_tolerance 2.0 --top 0 \
+    --benchmark_log_dir "$TRACE_DIR/plan_bench"
+grep -q plan_step_time_ratio "$TRACE_DIR/plan_bench/metric.log"
 
 echo "ci_check: OK"
