@@ -23,6 +23,16 @@ JPEG shards, with no device in the loop.  Prints ONE JSON line:
 Flags: --fast_dct (JDCT_IFAST decode), --scaled_decode (DCT-space
 1/2-1/8 decode for crops >=2x the target).
 
+--service switches to the data-service measurement
+(dtf_tpu/data/service): single-process inline baseline vs the
+--workers-process sharded pool (scaling + per-worker efficiency), plus
+the decode-once cache tier's epoch-2 warm rate and hit ratio — and the
+legacy threaded path measured alongside for A/B (--no_legacy skips
+it).  The pool numbers are the provisioning story: decode scales by
+PROCESS count (the measured serial fraction is GIL-held Python, so the
+legacy thread pool stops at ~1 core of Python no matter the core
+count), and epoch >= 2 skips libjpeg entirely.
+
 bench.py's combined report (r5) measures BOTH the fast_dct and exact
 configurations every round (`tuned_over_default`).  The r5 A/B retired
 the r3 "+39%/core" fast_dct figure: against the r4 fused-batch-op +
@@ -161,12 +171,119 @@ def measure(fast_dct: bool = False, scaled_decode: bool = False,
     }
 
 
+def _rate(stream, images: int, batch: int) -> float:
+    """images/s over one window of ``images`` from ``stream``."""
+    t0 = time.perf_counter()
+    seen = 0
+    while seen < images:
+        _, labels = next(stream)
+        seen += len(labels)
+    return seen / (time.perf_counter() - t0)
+
+
+def measure_service(num_shards: int = NUM_SHARDS, workers: int = 4,
+                    wire: str = "uint8", cache: bool = True,
+                    legacy: bool = True) -> dict:
+    """Data-service throughput: inline single-process baseline, the
+    ``workers``-process pool (scaling efficiency = speedup / workers),
+    and the decode-once cache tier's epoch-2 warm rate.  One JSON-able
+    dict; the legacy threaded pipeline rides along for A/B."""
+    from dtf_tpu.data.service import ServiceStream
+
+    batch = 64
+    window = MEASURE_IMAGES
+    cores = os.cpu_count() or 1
+    out = {
+        "metric": "imagenet_input_service_images_per_sec_per_host",
+        "unit": "images/sec/host",
+        "cores": cores, "num_shards": num_shards, "workers": workers,
+        "wire": wire, "chip_demand": CHIP_DEMAND,
+    }
+    with tempfile.TemporaryDirectory() as root:
+        make_shards(root, num_shards=num_shards)
+
+        # single-process baseline: every shard inline, no subprocess
+        base = ServiceStream(root, batch, seed=0, num_shards=num_shards,
+                             num_workers=0, wire=wire)
+        for _ in range(2):
+            next(base)  # warmup: file handles, first decode
+        base_rate = max(_rate(base, window, batch) for _ in range(2))
+        base.close()
+        out["single_process_rate"] = round(base_rate, 1)
+
+        # the worker pool (spawned processes; warmup absorbs spawn +
+        # first-batch latency so the window measures steady state)
+        pool = ServiceStream(root, batch, seed=0, num_shards=num_shards,
+                             num_workers=workers, wire=wire)
+        for _ in range(2 * max(workers, 1)):
+            next(pool)
+        pool_rates = [_rate(pool, window, batch) for _ in range(2)]
+        pool.close()
+        svc_rate = max(pool_rates)
+        scaling = svc_rate / base_rate
+        out["value"] = round(svc_rate, 1)
+        out["value_min"] = round(min(pool_rates), 1)
+        out["scaling_x"] = round(scaling, 2)
+        out["scaling_efficiency"] = round(
+            scaling / max(min(workers, num_shards, cores), 1), 2)
+        out["cores_needed_per_chip"] = round(
+            CHIP_DEMAND / (svc_rate / cores), 1)
+
+        if cache:
+            # decode-once cache: window 1 populates (cold decode +
+            # put), window 2 is the epoch-2 story — every record
+            # served from the mmap, libjpeg never runs
+            with tempfile.TemporaryDirectory() as cache_dir:
+                warm = ServiceStream(root, batch, seed=0,
+                                     num_shards=num_shards,
+                                     num_workers=workers, wire=wire,
+                                     cache_dir=cache_dir)
+                _rate(warm, num_shards * IMAGES_PER_SHARD, batch)  # populate
+                h0, l0 = warm.cache_stats()
+                out["cache_epoch2_rate"] = round(
+                    _rate(warm, window, batch), 1)
+                h1, l1 = warm.cache_stats()
+                # the epoch-2 WINDOW ratio (the cumulative lifetime
+                # ratio necessarily carries the populate pass's misses)
+                out["cache_hit_ratio"] = round(
+                    (h1 - h0) / max(l1 - l0, 1), 4)
+                warm.close()
+            out["cache_speedup_vs_single_process"] = round(
+                out["cache_epoch2_rate"] / base_rate, 2)
+
+    if legacy:
+        # the threaded pipeline, measured alongside: the A/B that shows
+        # where the thread pool's GIL ceiling sits vs process scaling
+        out["legacy_threaded"] = measure(wire=wire)
+    return out
+
+
 def main():
-    import sys
-    wire = "float32" if "--wire_f32" in sys.argv else "uint8"
-    print(json.dumps(measure(fast_dct="--fast_dct" in sys.argv,
-                             scaled_decode="--scaled_decode" in sys.argv,
-                             wire=wire)))
+    import argparse
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--fast_dct", action="store_true")
+    ap.add_argument("--scaled_decode", action="store_true")
+    ap.add_argument("--wire_f32", action="store_true")
+    ap.add_argument("--service", action="store_true",
+                    help="measure the sharded multi-process data "
+                         "service instead of the threaded pipeline")
+    ap.add_argument("--num_shards", type=int, default=NUM_SHARDS)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--no_cache", action="store_true",
+                    help="skip the decode-once cache measurement")
+    ap.add_argument("--no_legacy", action="store_true",
+                    help="skip the legacy threaded A/B measurement")
+    args = ap.parse_args()
+    wire = "float32" if args.wire_f32 else "uint8"
+    if args.service:
+        print(json.dumps(measure_service(
+            num_shards=args.num_shards, workers=args.workers, wire=wire,
+            cache=not args.no_cache, legacy=not args.no_legacy)))
+    else:
+        print(json.dumps(measure(fast_dct=args.fast_dct,
+                                 scaled_decode=args.scaled_decode,
+                                 wire=wire)))
 
 
 if __name__ == "__main__":

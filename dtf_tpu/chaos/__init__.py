@@ -16,7 +16,8 @@ Spec grammar (``--fault`` flag or the ``DTF_FAULT`` env var the
 launcher forwards; comma-separated specs compose)::
 
     spec  := kind "@" [ "rank" INT ":" ] point
-    point := "step" ":" INT | "version" ":" INT | "latest"
+    point := "step" ":" INT | "version" ":" INT | "batch" ":" INT
+             | "latest"
 
 Kinds and their firing semantics:
 
@@ -40,6 +41,13 @@ Kinds and their firing semantics:
                           checkpoint step before the next restore
                           (one-shot) — exercises the integrity manifest
                           fallback to the previous verified step.
+  reader_crash@batch:N    SIGKILLs the data-service shard worker that
+                          owns merged batch N, as the consumer reaches
+                          that batch (exact match, one-shot) — the
+                          service supervisor must respawn the worker at
+                          its recorded per-shard position and the
+                          merged stream must be unchanged
+                          (dtf_tpu/data/service).
 
 Every fired fault emits a structured ``injected_fault`` anomaly record
 through obs.trace (flushed before dying), so
@@ -64,13 +72,15 @@ log = logging.getLogger("dtf_tpu")
 EXIT_PREEMPTED = 75        # EX_TEMPFAIL: graceful preemption checkpoint
 EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
 
-KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate")
+KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
+         "reader_crash")
 _POINTS = {
     "crash": "step",
     "sigterm": "step",
     "heartbeat_stall": "step",
     "ps_drop": "version",
     "ckpt_truncate": "latest",
+    "reader_crash": "batch",
 }
 
 _injector: Optional["Injector"] = None
@@ -210,6 +220,18 @@ class Injector:
                     return True
         return False
 
+    def reader_crash(self, batch: int) -> bool:
+        """One-shot, EXACT-match: True when the data-service consumer
+        reaching merged batch `batch` should kill the owning shard
+        worker.  Exact match for the same reason as step(): a resumed
+        run positioned at/past the batch must not re-fire."""
+        with self._mu:
+            for spec in self._armed("reader_crash"):
+                if int(batch) == spec.value:
+                    self._record(spec, batch=int(batch))
+                    return True
+        return False
+
     def ckpt_truncate(self) -> bool:
         """One-shot: True when the next restore should first truncate
         the newest checkpoint step (the torn-write simulation)."""
@@ -294,6 +316,13 @@ def ckpt_truncate() -> bool:
     if inj is None:
         return False
     return inj.ckpt_truncate()
+
+
+def reader_crash(batch: int) -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.reader_crash(batch)
 
 
 if sys.platform == "win32":  # pragma: no cover - posix repo, belt+braces

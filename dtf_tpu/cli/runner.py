@@ -54,8 +54,8 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
     host_batch = global_batch // jax.process_count()
     # The TRAIN factory accepts start_step: crash-exact resume rebuilds
     # the stream positioned at the restored step (position-derived RNGs
-    # in the cifar/synthetic pipelines make batch n a pure function of
-    # (seed, n); imagenet re-keys best-effort — see its docstring).
+    # make batch n a pure function of (seed, n) in every pipeline —
+    # cifar/synthetic natively, imagenet via the sharded data service).
     if cfg.use_synthetic_data or not cfg.data_dir:
         fns = (
             lambda start_step=0: synthetic_input_fn(
@@ -74,13 +74,33 @@ def make_input_fns(cfg: Config, spec: DatasetSpec, global_batch: int):
         )
     elif spec.name == "imagenet":
         from dtf_tpu.data.imagenet import imagenet_input_fn
-        fns = (
-            lambda start_step=0: imagenet_input_fn(
+        if cfg.input_service:
+            # sharded deterministic multi-process service (the default):
+            # batch n is a pure function of (seed, process, n), so
+            # killed-at-K resume replays bit-exactly and decode scales
+            # across worker PROCESSES.  Eval stays on the threaded
+            # pipeline — one ordered unaugmented pass, nothing to make
+            # deterministic.
+            from dtf_tpu.data.service import service_input_fn
+            train_fn = lambda start_step=0: service_input_fn(
+                cfg.data_dir, host_batch, seed=cfg.seed,
+                num_shards=cfg.input_num_shards,
+                num_workers=cfg.input_workers,
+                wire=cfg.input_wire, cache_dir=cfg.input_cache_dir,
+                cache_limit_mb=cfg.input_cache_limit_mb,
+                start_step=start_step)
+        else:
+            # legacy threaded pipeline: fused native decode, NOT
+            # position-exact — a mid-stream resume refuses loudly
+            # inside imagenet_input_fn
+            train_fn = lambda start_step=0: imagenet_input_fn(
                 cfg.data_dir, True, host_batch, seed=cfg.seed,
                 num_threads=cfg.datasets_num_private_threads,
                 fast_dct=cfg.input_fast_dct,
                 scaled_decode=cfg.input_scaled_decode,
-                wire=cfg.input_wire, start_step=start_step),
+                wire=cfg.input_wire, start_step=start_step)
+        fns = (
+            train_fn,
             lambda: imagenet_input_fn(cfg.data_dir, False, host_batch,
                                       drop_remainder=cfg.drop_remainder,
                                       wire=cfg.input_wire),
@@ -117,6 +137,19 @@ def run(cfg: Config) -> dict:
     trace.maybe_configure(cfg)
     chaos.maybe_configure(cfg)
     preemption.install()
+    poller = None
+    if cfg.preemption_poll_s:
+        # metadata-server preemption signal (GCE/TPU-VM): a pending
+        # preemption visible on the metadata endpoint feeds the same
+        # SIGTERM latch the guard just installed
+        poller = preemption.MetadataPoller(cfg.preemption_poll_s).start()
+    metrics_server = None
+    if cfg.metrics_port and not (cfg.process_id or 0):
+        # rank 0 only (cfg.process_id is None for single-process runs
+        # and env-filled by the launcher otherwise — co-hosted ranks
+        # must not race for one port); stdlib server, daemon threads
+        from dtf_tpu.obs.prom import MetricsServer
+        metrics_server = MetricsServer(cfg.metrics_port)
     try:
         return _run(cfg)
     except preemption.Preempted as p:
@@ -125,6 +158,10 @@ def run(cfg: Config) -> dict:
         trace.flush()
         raise SystemExit(preemption.EXIT_PREEMPTED)
     finally:
+        if metrics_server is not None:
+            metrics_server.shutdown()
+        if poller is not None:
+            poller.stop()
         preemption.restore()
 
 
@@ -317,11 +354,30 @@ def _run(cfg: Config) -> dict:
         # The manifest carries the host half of crash-exact resume:
         # data position + the seed that derives the pipeline RNGs.
         spe = max(trainer.steps_per_epoch, 1)
-        host_state_fn = lambda step: {
-            "seed": cfg.seed, "global_step": step,
-            "epoch": step // spe, "step_in_epoch": step % spe,
-            "data": {"scheme": "position-derived", "dataset": cfg.dataset,
-                     "start_step": step}}
+        # mirror make_input_fns' branch order: synthetic/no-data_dir runs
+        # never touch the service, so their manifests must not claim its
+        # host_state (or resume would enforce num_shards against a
+        # stream that has no shards)
+        service_on = (spec.name == "imagenet" and cfg.input_service
+                      and bool(cfg.data_dir)
+                      and not cfg.use_synthetic_data)
+
+        def host_state_fn(step):
+            data = {"scheme": "position-derived", "dataset": cfg.dataset,
+                    "start_step": step}
+            if service_on:
+                # per-shard next-batch positions: derivable from the
+                # step alone, carried so the manifest is self-describing
+                # and the resume contract auditable — and num_shards,
+                # which is part of the stream's IDENTITY (the merged
+                # order depends on it), validated below on restore
+                from dtf_tpu.data.service import shard_positions
+                data["num_shards"] = cfg.input_num_shards
+                data["shard_positions"] = shard_positions(
+                    step, cfg.input_num_shards)
+            return {"seed": cfg.seed, "global_step": step,
+                    "epoch": step // spe, "step_in_epoch": step % spe,
+                    "data": data}
         ckpt_cb = ckpt_mod.CheckpointCallback(
             cfg.model_dir, every_steps=cfg.checkpoint_steps,
             host_state_fn=host_state_fn, keep=cfg.checkpoint_keep)
@@ -347,6 +403,21 @@ def _run(cfg: Config) -> dict:
                         f"with seed {host['seed']}, this run has "
                         f"--seed {cfg.seed}; crash-exact resume needs the "
                         f"same seed (pass --seed {host['seed']})")
+                ckpt_shards = (host or {}).get("data", {}).get("num_shards")
+                if service_on and ckpt_shards is not None \
+                        and int(ckpt_shards) != cfg.input_num_shards:
+                    # num_shards is part of the merged stream's identity
+                    # (batch n = shard n%S, local batch n//S): resuming
+                    # with a different count would silently continue on
+                    # a DIFFERENT stream than the run it claims to be
+                    raise ValueError(
+                        f"--resume input_num_shards mismatch: checkpoint "
+                        f"was written with {ckpt_shards} shard(s), this "
+                        f"run has --input_num_shards "
+                        f"{cfg.input_num_shards}; the merged batch order "
+                        f"depends on the shard count (pass "
+                        f"--input_num_shards {ckpt_shards}).  Worker "
+                        f"count, by contrast, may change freely")
             elif cfg.eval_only:
                 # evaluating random init as if it were a checkpoint would
                 # silently report garbage — fail instead

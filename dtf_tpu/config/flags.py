@@ -76,6 +76,35 @@ class Config:
     # reference keeps it in-graph too, imagenet_preprocessing.py:
     # 397-430).  "float32": host-side normalization (r1-r3 wire).
     input_wire: str = "uint8"
+    # --- host-side data service (dtf_tpu/data/service) ---
+    # Imagenet TRAIN batches come from the sharded deterministic
+    # multi-process service by default: batch n is a pure function of
+    # (seed, process, n), so killed-at-K resume is bit-exact and decode
+    # scales past the single-process GIL ceiling.  False = the legacy
+    # threaded pipeline (fused native decode; NOT position-exact — a
+    # mid-stream resume is refused loudly).
+    input_service: bool = True
+    # static shard count of the TFRecord file set.  Part of the stream's
+    # identity: the merged batch order depends on it, so a resumed run
+    # must keep the value the checkpoint was written with (validated
+    # from host_state).  Size it >= input_workers; the default (16)
+    # suits the production 1024-file layout — toy directories with
+    # fewer files than shards fail loudly with the flag to lower.
+    input_num_shards: int = 16
+    # spawned shard-worker processes; -1 (default) = auto: one per
+    # host core, capped by input_num_shards (inline when the host has
+    # a single core); 0 = run every shard inline (no subprocess —
+    # tests, benchmark baselines).  Worker count NEVER changes the
+    # stream — workers only decide who computes a batch, not what the
+    # batch is — so auto-sizing (and changing it across a resume) is
+    # safe by construction.
+    input_workers: int = -1
+    # decode-once cache tier: directory for the per-shard mmap-backed
+    # cache of decoded images ("" = off).  Epoch >= 2 and co-hosted
+    # replicas skip JPEG decode entirely; cached and uncached runs are
+    # bit-identical by construction.
+    input_cache_dir: str = ""
+    input_cache_limit_mb: int = 0       # per-shard cache byte bound; 0 = unbounded
     per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)
     tf_gpu_thread_mode: Optional[str] = None  # no-op compat
     batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)
@@ -277,6 +306,17 @@ class Config:
     # heartbeat file rewrite interval (launcher supervision); the file
     # is only written when the launcher exports DTF_HEARTBEAT_DIR
     heartbeat_secs: float = 5.0
+    # live scrape endpoint: rank 0 serves the obs registry as
+    # Prometheus text format over stdlib http.server on this port
+    # (GET /metrics).  0 = off (the default)
+    metrics_port: int = 0
+    # poll the GCE/TPU metadata preemption endpoint every N seconds in
+    # a daemon thread; a pending preemption feeds the SIGTERM latch
+    # (train/preemption.py), so the emergency-checkpoint path runs even
+    # when the scheduler signals via metadata before the SIGTERM lands.
+    # 0 = off (the default — most schedulers do deliver SIGTERM).
+    # DTF_METADATA_URL overrides the endpoint (tests, other clouds)
+    preemption_poll_s: float = 0.0
 
     # --- chaos (dtf_tpu/chaos: deterministic fault injection) ---
     # comma-separated fault specs, e.g. "crash@step:120",
@@ -377,6 +417,30 @@ class Config:
         if self.heartbeat_secs <= 0:
             raise ValueError(
                 f"heartbeat_secs must be positive, got {self.heartbeat_secs}")
+        if not 0 <= self.metrics_port <= 65535:
+            raise ValueError(
+                f"metrics_port must be in [0, 65535] (0 = off), got "
+                f"{self.metrics_port}")
+        if self.preemption_poll_s < 0:
+            raise ValueError(
+                f"preemption_poll_s must be >= 0 (0 = off), got "
+                f"{self.preemption_poll_s}")
+        if self.input_num_shards < 1:
+            raise ValueError(
+                f"input_num_shards must be >= 1, got "
+                f"{self.input_num_shards}")
+        if self.input_workers < -1:
+            raise ValueError(
+                f"input_workers must be >= -1 (-1 = auto, 0 = inline), "
+                f"got {self.input_workers}")
+        if self.input_cache_limit_mb < 0:
+            raise ValueError(
+                f"input_cache_limit_mb must be >= 0 (0 = unbounded), "
+                f"got {self.input_cache_limit_mb}")
+        if self.input_cache_limit_mb and not self.input_cache_dir:
+            raise ValueError(
+                "input_cache_limit_mb needs --input_cache_dir (the "
+                "decode-once cache is off without a directory)")
         if self.checkpoint_steps < 0:
             raise ValueError(
                 f"checkpoint_steps must be >= 0 (0 = per-epoch only), "

@@ -291,21 +291,19 @@ def imagenet_input_fn(data_dir: str, is_training: bool, batch_size: int,
     process_count = (jax.process_count() if process_count is None
                      else process_count)
     if is_training and start_step:
-        # Resume positioning, BEST-EFFORT: this pipeline's batch
-        # composition depends on decode-worker timing (the shuffle
-        # buffer drains nondeterministically across threads), so a
-        # bit-exact replay from step N is not defined.  What IS
-        # guaranteed: re-keying the stream by the resumed position
-        # gives a restarted run a fresh shuffle, so it neither replays
-        # the epoch prefix it already trained on nor repeats the exact
-        # crashed-run order — the "silently trains on repeated batches"
-        # failure mode is closed even where exactness can't be.
-        # (cifar/synthetic pipelines are position-derived and exact.)
-        import logging
-        logging.getLogger("dtf_tpu").warning(
-            "imagenet resume at step %d: threaded pipeline is re-keyed "
-            "(fresh shuffle), not bit-exact-replayed", start_step)
-        seed = int(seed) + 1_000_003 * int(start_step)
+        # This pipeline's batch composition depends on decode-worker
+        # timing (the shuffle buffer drains nondeterministically across
+        # threads), so a bit-exact replay from step N is not defined —
+        # and silently re-keying (the pre-data-service behavior) broke
+        # the crash-exact guarantee on the flagship workload.  The
+        # position-deterministic path exists: refuse loudly instead.
+        raise ValueError(
+            f"imagenet mid-stream resume (start_step={start_step}) is "
+            f"not supported by the legacy threaded pipeline — its batch "
+            f"order is decode-timing-dependent, so the resumed stream "
+            f"cannot replay bit-exactly.  Use the sharded deterministic "
+            f"data service (--input_service, the default), which makes "
+            f"batch n a pure function of (seed, process, n)")
     if wire not in ("float32", "uint8"):
         raise ValueError(f"wire must be 'float32' or 'uint8', got {wire!r}")
     u8 = wire == "uint8"

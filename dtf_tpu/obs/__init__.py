@@ -31,11 +31,13 @@ from dtf_tpu.obs import trace
 from dtf_tpu.obs.registry import (Counter, Gauge, Histogram,
                                   MetricsRegistry, default_registry)
 from dtf_tpu.obs.watchdog import (Heartbeat, NanLossWatchdog,
-                                  StepTimeWatchdog, TrainingAnomaly)
+                                  ReaderLagWatchdog, StepTimeWatchdog,
+                                  TrainingAnomaly)
 
 __all__ = [
     "trace",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "default_registry",
-    "Heartbeat", "NanLossWatchdog", "StepTimeWatchdog", "TrainingAnomaly",
+    "Heartbeat", "NanLossWatchdog", "ReaderLagWatchdog",
+    "StepTimeWatchdog", "TrainingAnomaly",
 ]

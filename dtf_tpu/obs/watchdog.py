@@ -125,6 +125,49 @@ class StepTimeWatchdog:
         return False
 
 
+class ReaderLagWatchdog:
+    """Report-only input-stall detector over per-batch reader lag.
+
+    The data service (dtf_tpu/data/service) reports how long the
+    consumer blocked waiting for each merged batch; this watchdog flags
+    a lag exceeding ``factor`` × the rolling median of recent batches —
+    AND an absolute floor ``min_lag_s``, so microsecond-scale jitter on
+    a well-fed pipeline can never page — with a structured
+    ``reader_lag`` anomaly.  Reports, never aborts: a starving device
+    is a provisioning problem (add input workers/cores), not a poisoned
+    run.  Same shape as StepTimeWatchdog: the triggering value is not
+    added to the baseline, so a genuine stall keeps triggering."""
+
+    def __init__(self, factor: float = 10.0, min_lag_s: float = 0.5,
+                 window: int = 64, warmup: int = 8):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1.0, got {factor}")
+        self.factor = float(factor)
+        self.min_lag_s = float(min_lag_s)
+        self.warmup = max(int(warmup), 1)
+        self._history: deque = deque(maxlen=max(int(window), self.warmup))
+        self.trigger_count = 0
+
+    def observe(self, batch: int, lag_s: float) -> bool:
+        lag_s = float(lag_s)
+        if len(self._history) >= self.warmup and lag_s > self.min_lag_s:
+            median = statistics.median(self._history)
+            if lag_s > self.factor * max(median, 1e-9):
+                self.trigger_count += 1
+                trace.anomaly("reader_lag", batch=int(batch),
+                              lag_s=lag_s, median_s=median,
+                              factor=self.factor)
+                log.warning(
+                    "reader-lag watchdog: batch %d waited %.3fs on the "
+                    "input pipeline vs rolling median %.4fs (>%gx) — "
+                    "the device is input-starved; add data-service "
+                    "workers or host cores", batch, lag_s, median,
+                    self.factor)
+                return True
+        self._history.append(lag_s)
+        return False
+
+
 class Heartbeat:
     """Liveness file the launcher supervisor watches.
 
