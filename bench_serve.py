@@ -21,6 +21,12 @@ Prints ONE JSON line per metric, bench.py contract ({"metric", "value",
      concurrent slots, and the page-pool high-water mark.  Bars:
      paged@50% ≥ 1.2× contiguous tokens/s at ≥ the same concurrency;
      chunked p99 gap < un-chunked p99 gap.
+  4. SHARED-PREFIX scenario: N concurrent requests over one system
+     prompt against a pool too small for N unshared copies, sharing
+     on vs off, every handle consumed through its token stream.
+     Bars: sharing fits ≥ 2× the concurrent sequences of no-sharing
+     at equal page budget; first-streamed-token p50 < full-retire
+     p50.
 
 Run: python bench_serve.py [--model transformer_small] [--batch 8]
      [--steps 64] [--seq 256]
@@ -48,6 +54,22 @@ import numpy as np
 def _jline(metric, value, unit, **extra):
     print(json.dumps({"metric": metric, "value": round(float(value), 4),
                       "unit": unit, "vs_baseline": None, **extra}))
+
+
+# shared-prefix scenario shape, single-sourced: the pool sizing in
+# main() (and tools/serve_smoke.py) must agree with the traffic the
+# scenario generates, or the >=2x concurrency bar measures a wrong
+# page budget
+PREFIX_TAIL_LEN = 8        # per-request tokens after the system prompt
+PREFIX_BUDGET = 24         # per-request max_new_tokens
+
+
+def prefix_pool_pages(batch: int, sys_pages: int, page_size: int) -> int:
+    """Total pool pages (incl. scratch) sized so ONE full prompt copy
+    plus per-request tails fit, but `batch` unshared copies cannot."""
+    tail_pages = (-(-(sys_pages * page_size + PREFIX_TAIL_LEN
+                      + PREFIX_BUDGET) // page_size) - sys_pages)
+    return 1 + (sys_pages + tail_pages) + (batch - 1) * tail_pages
 
 
 def decode_tokens_per_s(model, params, batch: int, seq: int,
@@ -145,6 +167,92 @@ def mixed_scenario(model, params, *, batch: int, seq: int, requests: int,
                pool_usable=eng.pool.usable_pages,
                page_size=eng.page_size)
     return stats, gap, maxc, high
+
+
+def shared_prefix_scenario(model, params, *, batch: int, seq: int,
+                           requests: int, kv_page_size: int,
+                           kv_pool_pages: int, sys_pages: int,
+                           prefix_sharing: bool, label: str):
+    """N concurrent requests sharing one system prompt, against a pool
+    deliberately too small to hold N unshared copies.
+
+    The warm request writes + registers the system prefix (sharing
+    arm) and compiles every shape; the measured burst then admits with
+    ``sys_pages`` of each prompt shared — so concurrency is bounded by
+    the per-request TAIL pages, not the full prompt.  Every handle is
+    consumed through its token STREAM by a client thread, recording
+    first-streamed-token latency next to full-retire latency — the
+    streaming win is the gap between those two columns.
+
+    Returns (stats, max_concurrent, high_water, ttft_stream_p50,
+    full_latency_p50)."""
+    import concurrent.futures as cf
+    import threading
+
+    from dtf_tpu.serve import ServeEngine, collect_stats
+    eng = ServeEngine(model, params, max_batch=batch, max_seq_len=seq,
+                      max_delay_s=0.0, queue_size=max(64, 2 * requests),
+                      kv_page_size=kv_page_size,
+                      kv_pool_pages=kv_pool_pages,
+                      prefix_sharing=prefix_sharing)
+    ps = kv_page_size
+    rng = np.random.default_rng(5)
+    sys_prompt = rng.integers(0, model.vocab_size,
+                              (sys_pages * ps,)).astype(np.int32)
+    budget = PREFIX_BUDGET
+    # warm: registers the system prefix (sharing arm) and compiles the
+    # prefill/decode shapes for both arms
+    eng.submit(sys_prompt, max_new_tokens=2).result(timeout=600)
+    n_warm = eng.reset_measurement()
+    first_times = {}
+    lock = threading.Lock()
+
+    def _consume(rid, handle, t_submit):
+        for _ in handle.stream(timeout=600):
+            with lock:
+                if rid not in first_times:
+                    first_times[rid] = time.perf_counter() - t_submit
+
+    t0 = time.time()
+    handles = []
+    with cf.ThreadPoolExecutor(max_workers=requests) as ex:
+        consumers = []
+        for r in range(requests):
+            tail = rng.integers(0, model.vocab_size,
+                                (PREFIX_TAIL_LEN,)).astype(np.int32)
+            h = eng.submit(np.concatenate([sys_prompt, tail]),
+                           max_new_tokens=budget)
+            handles.append(h)
+            consumers.append(ex.submit(_consume, r, h,
+                                       time.perf_counter()))
+        results = [h.result(timeout=600) for h in handles]
+        for c in consumers:
+            c.result()       # propagate consumer-thread failures loudly
+    wall = time.time() - t0
+    stats = collect_stats(eng.completed[n_warm:], eng.shed_count,
+                          wall_time_s=wall)
+    maxc = eng.max_concurrent
+    high = eng.pool.high_water
+    hits = eng.metrics.get("serve_prefix_hit_pages_total").value
+    eng.stop()
+    lat = sorted(r.latency_s for r in results)
+    ttft = sorted(first_times.values())
+    if not ttft:
+        # a 0.0 default would pass the ttft < full-retire bar VACUOUSLY
+        raise SystemExit(
+            f"shared-prefix scenario ({label}): no first-token times "
+            f"recorded — the streaming path produced no tokens")
+    ttft_p50 = ttft[len(ttft) // 2]
+    full_p50 = lat[len(lat) // 2]
+    _jline(f"serve_prefix_tokens_per_s_{label}", stats.tokens_per_s,
+           "tokens/s", requests=stats.num_requests)
+    _jline(f"serve_prefix_max_concurrent_{label}", maxc, "slots",
+           pool_usable=kv_pool_pages - 1, sys_pages=sys_pages)
+    _jline(f"serve_prefix_pages_high_water_{label}", high, "pages",
+           shared_hit_pages=hits)
+    _jline(f"serve_stream_ttft_p50_{label}", ttft_p50, "s",
+           full_retire_p50=round(full_p50, 4), budget_tokens=budget)
+    return stats, maxc, high, ttft_p50, full_p50
 
 
 def main():
@@ -257,6 +365,30 @@ def main():
            else 0.0, "x",
            chunked_below_unchunked=bool(g_chunk["p99"] < g_plain["p99"]))
 
+    # shared-prefix scenario: N requests over one system prompt, pool
+    # sized so unshared copies CANNOT all fit — prefix sharing must at
+    # least double the concurrent sequences at equal page budget, and
+    # streaming must deliver the first token well before full retire
+    sys_pages = 8
+    prefix_pool = prefix_pool_pages(args.batch, sys_pages, ps)
+    _, c_share, hw_share, ttft_stream, full_p50 = shared_prefix_scenario(
+        model, params, batch=args.batch, seq=args.seq,
+        requests=args.batch, kv_page_size=ps, kv_pool_pages=prefix_pool,
+        sys_pages=sys_pages, prefix_sharing=True, label="sharing")
+    _, c_noshare, hw_noshare, _, _ = shared_prefix_scenario(
+        model, params, batch=args.batch, seq=args.seq,
+        requests=args.batch, kv_page_size=ps, kv_pool_pages=prefix_pool,
+        sys_pages=sys_pages, prefix_sharing=False, label="nosharing")
+    _jline("serve_prefix_concurrency_gain",
+           (c_share / c_noshare) if c_noshare else 0.0, "x",
+           sharing=c_share, nosharing=c_noshare,
+           meets_2x_bar=bool(c_share >= 2 * c_noshare))
+    _jline("serve_stream_first_token_gain",
+           (full_p50 / ttft_stream) if ttft_stream > 0 else 0.0, "x",
+           stream_ttft_p50=round(ttft_stream, 4),
+           full_retire_p50=round(full_p50, 4),
+           streaming_earlier=bool(ttft_stream < full_p50))
+
     # acceptance bars, enforced the same way as the 2x decode bar — a
     # printed false boolean that exits 0 is not a contract
     if ratio < 2.0:
@@ -272,6 +404,16 @@ def main():
             f"chunked prefill did not bound the decode gap: p99 "
             f"{g_chunk['p99']:.3f}s chunked vs {g_plain['p99']:.3f}s "
             f"un-chunked")
+    if c_share < 2 * c_noshare:
+        raise SystemExit(
+            f"prefix-sharing bar failed: {c_share} concurrent sequences "
+            f"sharing vs {c_noshare} without (bar: >= 2x) at "
+            f"{prefix_pool - 1} usable pages")
+    if ttft_stream >= full_p50:
+        raise SystemExit(
+            f"streaming bar failed: first streamed token p50 "
+            f"{ttft_stream:.3f}s is not below full-retire p50 "
+            f"{full_p50:.3f}s")
 
 
 if __name__ == "__main__":

@@ -239,6 +239,13 @@ def main(argv=None) -> int:
         cost = predict(plan, stats, mesh, cfg.batch_size,
                        optimizer=cfg.optimizer)
         ranked = [RankedPlan(plan, cost, violations)]
+    elif cfg.plan_cache:
+        from dtf_tpu.plan.cache import cached_search
+        ranked, hit = cached_search(cfg.plan_cache, stats, mesh,
+                                    cfg.batch_size,
+                                    optimizer=cfg.optimizer)
+        print(f"plan cache: {'HIT — search skipped' if hit else 'miss'} "
+              f"({cfg.plan_cache})")
     else:
         ranked = search(stats, mesh, cfg.batch_size,
                         optimizer=cfg.optimizer)

@@ -3,8 +3,16 @@
 The training mains end at a checkpoint; this main is its consumer: it
 loads train-format (or --export_dir-format) variables through the
 serve bridge, stands up the dynamic batching engine, drives it with
-synthetic traffic, and reports latency percentiles + tokens/s in the
-BenchmarkMetric format (--benchmark_log_dir writes metric.log).
+synthetic traffic — every request consumed through its token STREAM —
+and reports latency percentiles + tokens/s in the BenchmarkMetric
+format (--benchmark_log_dir writes metric.log).
+
+`--serve_tp N` serves tensor-parallel: an N-chip 'model' mesh, params
+restored DIRECTLY into the Megatron layout (no replicated
+intermediate) and the KV page pool sharded on its head dim — a model
+that trains sharded never has to fit on one chip to serve.
+`--serve_prefix_sharing` (default on, paged cache) makes a shared
+system prompt cost one physical page copy across the batch.
 
 Examples:
   # serve a trained LM checkpoint:
@@ -45,7 +53,7 @@ def serve(cfg, random_init: bool = False) -> dict:
     traffic demo; return the stats dict.  Library entry for tests."""
     from dtf_tpu.models import build_model
     from dtf_tpu.serve import (ServeEngine, collect_stats, load_for_serving,
-                               serving_memory_plan)
+                               serving_memory_plan, serving_mesh)
     from dtf_tpu.serve.bridge import place_for_serving
 
     if not cfg.model.startswith("transformer"):
@@ -55,16 +63,22 @@ def serve(cfg, random_init: bool = False) -> dict:
     model, _ = build_model(cfg.model, num_classes=cfg.num_classes,
                            dtype=cfg.compute_dtype)
     max_seq = cfg.serve_max_seq_len or model.max_seq_len
+    # --serve_tp N: an N-chip 'model'-axis mesh; the bridge restores
+    # DIRECTLY into the Megatron layout (no replicated intermediate)
+    # and the engine's Decoder runs every step under shard_map
+    mesh = serving_mesh(cfg.serve_tp) if cfg.serve_tp > 1 else None
     if random_init:
         log.warning("--serve_random_init: serving FRESH parameters — "
                     "pipeline smoke test only, outputs are noise")
         variables = {"params": model.init(
             jax.random.key(cfg.seed),
             jnp.zeros((1, max_seq), jnp.int32))["params"]}
-        variables = place_for_serving(variables)
+        variables = place_for_serving(variables, mesh=mesh,
+                                      model_parallelism=cfg.serve_tp)
     else:
         variables = load_for_serving(model_dir=cfg.model_dir,
-                                     export_dir=cfg.export_dir)
+                                     export_dir=cfg.export_dir, mesh=mesh,
+                                     model_parallelism=cfg.serve_tp)
 
     # paged KV cache by default (--kv_page_size 0 restores the
     # contiguous per-slot layout); the memory plan makes pool sizing a
@@ -72,7 +86,8 @@ def serve(cfg, random_init: bool = False) -> dict:
     serving_memory_plan(model, num_slots=cfg.serve_max_batch,
                         max_seq_len=max_seq,
                         kv_page_size=cfg.kv_page_size,
-                        kv_pool_pages=cfg.kv_pool_pages)
+                        kv_pool_pages=cfg.kv_pool_pages,
+                        model_parallelism=cfg.serve_tp)
     engine = ServeEngine(
         model, variables["params"],
         max_batch=cfg.serve_max_batch, max_seq_len=max_seq,
@@ -83,7 +98,9 @@ def serve(cfg, random_init: bool = False) -> dict:
         # Config.validate guarantees serve_prefill_chunk is None when
         # the paged cache is off, so this never trips the engine's
         # contradiction check
-        prefill_chunk=cfg.serve_prefill_chunk)
+        prefill_chunk=cfg.serve_prefill_chunk,
+        prefix_sharing=cfg.serve_prefix_sharing and bool(cfg.kv_page_size),
+        mesh=mesh)
 
     # serve drain: SIGTERM (the preemption signal) stops admissions —
     # new submits shed with retry_after — finishes in-flight decodes,
@@ -109,21 +126,42 @@ def serve(cfg, random_init: bool = False) -> dict:
     vocab = model.vocab_size
     handles = []
     shed_by_drain = 0
+    streamed_tokens = 0
     t0 = time.time()
+
+    def _consume(handle):
+        # the streaming client shape: render each token as its decode
+        # step retires (first-token latency, not full-retire latency).
+        # Tokens counted here flowed through the per-token path; the
+        # engine's serve_stream_lag_s histogram records consumer lag
+        n = 0
+        for _ in handle.stream(timeout=600):
+            n += 1
+        return n
+
     try:
+        import concurrent.futures as cf
+
         # synthetic traffic: varied-length prompts, all submitted up
-        # front (a burst — the shape that exercises batching + queue)
-        for _ in range(cfg.serve_requests):
-            plen = int(rng.integers(1, cfg.serve_prompt_len + 1))
-            prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
-            try:
-                handles.append(engine.submit(
-                    prompt, max_new_tokens=cfg.serve_max_new_tokens,
-                    temperature=cfg.serve_temperature))
-            except Backpressure:
-                # drain (or a genuinely full queue): the request is the
-                # client's to retry elsewhere
-                shed_by_drain += 1
+        # front (a burst — the shape that exercises batching + queue),
+        # each consumed through its token STREAM by a client thread
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            consumers = []
+            for _ in range(cfg.serve_requests):
+                plen = int(rng.integers(1, cfg.serve_prompt_len + 1))
+                prompt = rng.integers(0, vocab, (plen,)).astype(np.int32)
+                try:
+                    h = engine.submit(
+                        prompt, max_new_tokens=cfg.serve_max_new_tokens,
+                        temperature=cfg.serve_temperature)
+                except Backpressure:
+                    # drain (or a genuinely full queue): the request is
+                    # the client's to retry elsewhere
+                    shed_by_drain += 1
+                    continue
+                handles.append(h)
+                consumers.append(ex.submit(_consume, h))
+            streamed_tokens = sum(c.result() for c in consumers)
         for h in handles:
             h.result(timeout=600)
         wall = time.time() - t0
@@ -153,6 +191,8 @@ def serve(cfg, random_init: bool = False) -> dict:
         "latency_p50_s": stats.latency_p50_s,
         "latency_p99_s": stats.latency_p99_s,
         "ttft_p50_s": stats.ttft_p50_s,
+        "streamed_tokens": streamed_tokens,
+        "tp": cfg.serve_tp,
     }
     log.info("Serve stats: %s", out)
     return out

@@ -7,7 +7,14 @@ max), event counts, and every anomaly record.
 
 Usage:
   python -m dtf_tpu.cli.trace_main <trace_dir | trace.jsonl> [...]
-      [--check] [--allow <kind>]... [--json]
+      [--check] [--allow <kind>]... [--json] [--merge]
+
+``--merge`` emits ONE time-ordered cross-rank stream (JSONL on stdout)
+instead of the aggregate table: every record from every
+``trace_rank{N}.jsonl`` sorted by timestamp, rank-tagged — the view
+that answers "what was rank 2 doing when rank 0 stalled?".  Spans sort
+by their START time (``ts``), so a long span appears where it began,
+interleaved with what ran under it.  Composes with ``--check``.
 
 ``--check`` is the CI/bench contract: exit 0 only when the trace
 contains NO anomaly records (nan_loss, step_time_regression, ...), so a
@@ -29,6 +36,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import sys
 from collections import Counter as CCounter
 from typing import Dict, List
@@ -50,6 +58,30 @@ def discover(paths: List[str]) -> List[str]:
         else:
             files.append(p)
     return files
+
+
+def _rank_from_path(path: str) -> int:
+    # the writer's naming contract, not "any digits": a rotated
+    # trace_rank2.jsonl.1 or a v4_trace_rank2.jsonl prefix must still
+    # resolve rank 2
+    m = re.search(r"trace_rank(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def merge_records(files: List[str]) -> List[dict]:
+    """All records from all per-rank files as one stream, sorted by
+    timestamp (ties broken by rank for a stable order).  Every record
+    is rank-tagged — the writer stamps ``rank``; records from an older
+    trace without it inherit the rank from the filename."""
+    merged: List[dict] = []
+    for path in files:
+        fallback = _rank_from_path(path)
+        for rec in read_records(path):
+            rec.setdefault("rank", fallback)
+            merged.append(rec)
+    merged.sort(key=lambda r: (float(r.get("ts", 0.0)),
+                               int(r.get("rank", 0))))
+    return merged
 
 
 def summarize(files: List[str]) -> dict:
@@ -134,20 +166,33 @@ def main(argv=None) -> int:
                          "assert 'only the injected fault'")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
+    ap.add_argument("--merge", action="store_true",
+                    help="emit one time-ordered cross-rank JSONL stream "
+                         "(rank-tagged records) instead of the summary")
     args = ap.parse_args(argv)
 
     files = discover(args.paths)
-    summary = summarize(files)
     allowed = set(args.allow)
-    if args.json:
-        print(json.dumps(summary, indent=2, default=str))
+    if args.merge:
+        # one pass over the files: the merged stream also feeds the
+        # --check anomaly scan (no summarize — the aggregate view is
+        # never printed in merge mode)
+        merged = merge_records(files)
+        for rec in merged:
+            print(json.dumps(rec, default=str))
+        anomalies = [r for r in merged if r.get("kind") == "anomaly"]
     else:
-        print_summary(summary, allowed=allowed)
+        summary = summarize(files)
+        if args.json:
+            print(json.dumps(summary, indent=2, default=str))
+        else:
+            print_summary(summary, allowed=allowed)
+        anomalies = summary["anomalies"]
     if args.check:
-        blocked = [a for a in summary["anomalies"]
+        blocked = [a for a in anomalies
                    if a.get("name") not in allowed]
         if blocked:
-            tolerated = len(summary["anomalies"]) - len(blocked)
+            tolerated = len(anomalies) - len(blocked)
             print(f"--check: {len(blocked)} anomaly record(s)"
                   + (f" ({tolerated} allowed)" if tolerated else "")
                   + " — run was NOT clean", file=sys.stderr)

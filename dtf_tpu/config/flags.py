@@ -264,6 +264,18 @@ class Config:
     # decode steps for running slots; 0 = whole-prompt single chunk;
     # None (default) = 4 pages, valid at ANY page size
     serve_prefill_chunk: Optional[int] = None
+    # serving tensor parallelism: shard decode params (Megatron
+    # column/row layout) and every layer's KV page pool (head dim)
+    # over a 'model' mesh axis of this many chips — the bridge
+    # restores train/export/ZeRO checkpoints DIRECTLY into the sharded
+    # layout, so a model that trains sharded never has to fit on one
+    # chip to serve.  Needs the paged cache (kv_page_size > 0)
+    serve_tp: int = 1
+    # prefix sharing (paged cache): refcounted pages + a token-id-hash
+    # registry of full prompt-prefix pages — N requests sharing a
+    # system prompt cost ONE physical copy; copy-on-write protects the
+    # one shared-page write (serve/engine.py module docs)
+    serve_prefix_sharing: bool = True
 
     # --- parallelism planner (dtf_tpu/plan) ---
     # "" = off (hand-set flags rule, the pre-planner behavior);
@@ -279,6 +291,11 @@ class Config:
     # topology, a preset (cpu | v4-8 | 4x4), or an explicit
     # "hosts=4,devices=4,hbm=32g,flops=140t,intra=100g,inter=25g"
     plan_mesh: str = ""
+    # ranked-lattice memoization sidecar (plan/cache.py): a JSON file
+    # keyed by (workload, mesh descriptor, batch) — repeated
+    # `--plan auto` resolves (launcher restarts!) and plan_main
+    # rankings skip the search on a hit.  "" = off
+    plan_cache: str = ""
     # cross-run checkpoint GC by verified-set (train/checkpoint.py
     # Checkpointer.gc): after training, delete all but the newest N
     # sha256-VERIFIED steps (steps newer than the newest verified one —
@@ -410,6 +427,13 @@ class Config:
             raise ValueError(
                 "kv_pool_pages / serve_prefill_chunk need the paged "
                 "cache (kv_page_size > 0)")
+        if self.serve_tp < 1:
+            raise ValueError(f"serve_tp must be >= 1, got {self.serve_tp}")
+        if self.serve_tp > 1 and not self.kv_page_size:
+            raise ValueError(
+                "serve_tp > 1 (tensor-parallel serving) needs the paged "
+                "KV cache (kv_page_size > 0) — the page pool is the "
+                "layout that shards")
         if self.step_time_guard_factor and self.step_time_guard_factor <= 1.0:
             raise ValueError(
                 f"step_time_guard_factor must be > 1.0 (or 0 to disable), "

@@ -52,6 +52,23 @@ log = logging.getLogger("dtf_tpu")
 _ERROR = "__error__"
 
 
+def _supervisor_event(event: str, **attrs) -> None:
+    """Append one record to the launcher's ``supervisor_events.jsonl``
+    (via cli/launch.py SupervisorEventLog — ONE schema for every
+    supervision record) when this rank runs under the launcher —
+    post-mortems then see reader-restart decisions WITH their data
+    positions next to the supervisor's own rank-restart records.  The
+    launcher exports its log dir as DTF_HEARTBEAT_DIR; standalone runs
+    (no env) skip silently, and SupervisorEventLog already swallows a
+    full disk."""
+    sup_dir = os.environ.get("DTF_HEARTBEAT_DIR")
+    if not sup_dir:
+        return
+    from dtf_tpu.cli.launch import SupervisorEventLog
+    SupervisorEventLog(sup_dir).emit(
+        event, rank=int(os.environ.get("DTF_PROCESS_ID", "0")), **attrs)
+
+
 def shard_positions(step: int, num_shards: int) -> List[int]:
     """Per-shard next-batch positions after ``step`` merged batches —
     the host_state payload a checkpoint carries so the resume contract
@@ -230,6 +247,14 @@ class ServiceStream:
         trace.event("reader_respawn", worker=w, exitcode=exitcode,
                     reason=reason, positions=[self._need[s]
                                               for s in shards])
+        # the restart decision, with its data positions, lands in the
+        # launcher's supervisor_events.jsonl: the post-mortem view of
+        # "worker 2 died at shard 3 batch 17" next to the supervisor's
+        # rank-level restart records
+        _supervisor_event(
+            "reader_crash", worker=w, exitcode=exitcode, reason=reason,
+            respawns=self.respawns,
+            shard_positions={str(s): int(self._need[s]) for s in shards})
         self._spawn(w)
 
     # -- merged stream --------------------------------------------------

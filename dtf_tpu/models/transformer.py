@@ -52,8 +52,8 @@ import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
 from dtf_tpu.ops.flash_attention import flash_attention
-from dtf_tpu.ops.paged_attention import (cached_attention, paged_attention,
-                                         write_pages)
+from dtf_tpu.ops.paged_attention import (cached_attention,
+                                         paged_attention_auto, write_pages)
 from dtf_tpu.parallel.collectives import tp_psum, tp_region
 from dtf_tpu.parallel.ring_attention import ring_attention
 
@@ -105,12 +105,16 @@ class CausalSelfAttention(nn.Module):
         b, s, d = x.shape
         head_dim = d // self.num_heads
         heads = self.num_heads
-        if self.decode and (self.seq_axis is not None
-                            or self.model_axis is not None):
-            # checked before tp_region/psum touch the (unbound) axes
+        if self.decode and self.seq_axis is not None:
+            # checked before the ring touches the (unbound) axis.
+            # model_axis DOES compose with decode: serving tensor
+            # parallelism shards heads (and the KV page pool's head
+            # dim) over 'model' — the attention math is per-head, so
+            # each shard decodes its local heads and the row-parallel
+            # out projection psums exactly as in training
             raise ValueError(
-                "decode mode (KV cache) is single-device: it does not "
-                "compose with seq_axis/model_axis sharding")
+                "decode mode (KV cache) does not compose with seq_axis "
+                "sharding (ring attention)")
         if self.model_axis is not None:
             x = tp_region(x, self.model_axis)
             # lax.psum of a Python scalar is the static axis size, so
@@ -159,18 +163,23 @@ class CausalSelfAttention(nn.Module):
                     o = flash_attention(q, k, v, causal=True,
                                         use_pallas=self.use_pallas)
                 else:
-                    # window_pages (STATIC, decode.py computes it from
-                    # the chunk's start) trims the gather to the pages
-                    # the chunk can actually see: continuation-chunk
-                    # attention costs O(S · progress), so total prefill
-                    # work is O(prompt²/2) regardless of the pool's
-                    # logical capacity.  None (the decode step) attends
-                    # the full per-slot window — lengths vary per row
-                    table = (block_table if window_pages is None
-                             else block_table[:, :window_pages])
-                    o = paged_attention(q, paged_key.value,
-                                        paged_value.value, table,
-                                        cache_index)
+                    # paged_attention_auto: the Pallas flash-decode
+                    # kernel on TPU (default-on — pages read through
+                    # the block table in-kernel, no gathered window,
+                    # window trim fused as a dynamic page skip), the
+                    # gather oracle elsewhere.  window_pages (STATIC,
+                    # decode.py computes it from the chunk's start)
+                    # trims the GATHER path to the pages the chunk can
+                    # actually see: continuation-chunk attention costs
+                    # O(S · progress), so total prefill work is
+                    # O(prompt²/2) regardless of the pool's logical
+                    # capacity.  None (the decode step) attends the
+                    # full per-slot window — lengths vary per row
+                    o = paged_attention_auto(
+                        q, paged_key.value, paged_value.value,
+                        block_table, cache_index,
+                        window_pages=window_pages,
+                        use_pallas=self.use_pallas)
             else:
                 # init trace: only the pool variables' shapes matter,
                 # but keep the math valid (plain causal attention)
@@ -308,8 +317,10 @@ class TransformerLM(nn.Module):
     # a KV cache in the 'cache' collection, sized by the INIT call's
     # sequence length, and __call__ takes `cache_index` [B] int32 — the
     # per-row write offset (each request's current length, which is what
-    # makes slot-based continuous batching possible).  Incompatible with
-    # seq/model sharding and shard_vocab (decode is single-device).
+    # makes slot-based continuous batching possible).  Composes with
+    # model_axis (serving tensor parallelism: heads + KV pool sharded
+    # over 'model', run inside shard_map — serve/decode.py Decoder);
+    # incompatible with seq_axis sharding and shard_vocab.
     decode: bool = False
     # Paged KV cache (decode only; serve/decode.py Decoder drives it):
     # instead of a per-slot [B, max_seq_len] slab, every attention keeps

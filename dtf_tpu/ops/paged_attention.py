@@ -31,12 +31,39 @@ the decode step compiles exactly once regardless of pool occupancy.
 window, f32 softmax) also lives here — it is the shared score/softmax
 math for both the contiguous cache path (models/transformer.py) and
 the paged gather path.
+
+Two formulations of attention-over-pages coexist:
+
+  gather (``paged_attention``)      — materialize the gathered window,
+      mask, dense softmax.  Portable, the CPU-default oracle.  Pays the
+      PR-3 gather tax (~3% of contiguous step time) plus, for prefill
+      chunks, a host-side STATIC window trim (one compile per window).
+  kernel (``paged_flash_decode``)   — a Pallas kernel that reads KV
+      pages THROUGH the block table in-kernel (scalar-prefetched, so
+      each page's DMA source address is computed before the body runs):
+      no gathered window ever materializes, and the window trim is
+      FUSED — pages past ``index + S − 1`` are skipped by a dynamic
+      ``pl.when`` predicate, so one compile covers every chunk index
+      where the gather path needed one per static window.  Online-
+      softmax carry in VMEM scratch (ops.blockwise math, the same rule
+      the flash kernels use).
+
+``paged_attention_auto`` dispatches between them: the kernel by default
+on TPU, the gather oracle elsewhere; ``use_pallas="interpret"`` runs
+the kernel through the Pallas interpreter on CPU (how tier-1 pins
+kernel ≡ oracle).
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dtf_tpu.ops import blockwise as bw
 
 
 def cached_attention(q, k, v, mask):
@@ -134,3 +161,152 @@ def paged_attention(q, pool_k, pool_v, block_table, index):
     qpos = (index[:, None, None]
             + jnp.arange(s, dtype=jnp.int32)[None, :, None])
     return cached_attention(q, k, v, jpos <= qpos)
+
+
+# ---------------------------------------------------------------------------
+# Pallas paged flash-decode kernel
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(tbl_ref, idx_ref, q_ref, k_ref, v_ref, o_ref,
+                         oacc_ref, m_ref, l_ref, *, scale, page_size):
+    """Grid (B, H, M): one (row, head) pair streams its pages.
+
+    ``tbl_ref`` [B, M] and ``idx_ref`` [B] are scalar-prefetched: the
+    pool in_specs' index maps read ``tbl_ref[b, j]`` to pick the DMA
+    source page BEFORE the body runs — the gather never exists as an
+    array.  The online-softmax carry (un-normalized o in f32, running
+    max m, denominator l — ops.blockwise math, shared with the flash
+    kernels) lives in VMEM scratch across the sequential page
+    dimension.  Pages whose first position lies past ``index + S − 1``
+    are skipped by a DYNAMIC predicate — the window trim the gather
+    path did with a static slice, fused, so one compile covers every
+    chunk index.  Within a live page the causal mask is positional:
+    key position ``j·page + t`` is admitted iff ≤ ``index + i`` (the
+    query's global position) — exactly the gather oracle's mask."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    s = q_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        oacc_ref[...] = jnp.zeros_like(oacc_ref)
+        m_ref[...] = jnp.full_like(m_ref, bw.NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    idx = idx_ref[b]
+    live = j * page_size <= idx + s - 1
+
+    @pl.when(live)
+    def _accumulate():
+        kpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        qpos = idx + jax.lax.broadcasted_iota(jnp.int32, (s, 1), 0)
+        bias = jnp.where(kpos <= qpos, 0.0, bw.NEG_INF)
+        o, m, l = bw.block_accumulate(
+            oacc_ref[...], m_ref[...][:, 0], l_ref[...][:, 0],
+            q_ref[...], k_ref[...], v_ref[...], scale, bias)
+        oacc_ref[...] = o
+        m_ref[...] = m[:, None]
+        l_ref[...] = l[:, None]
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        o_ref[...] = bw.finalize(
+            oacc_ref[...], l_ref[...][:, 0]).astype(o_ref.dtype)
+
+
+def paged_flash_decode(q, pool_k, pool_v, block_table, index, *,
+                       scale=None, interpret: bool = False):
+    """Attention of a chunk of queries over a slot's paged KV history,
+    reading pages through the block table IN-KERNEL.
+
+    Same contract as :func:`paged_attention` (write-then-attend; q
+    [B, S, H, Dh], pools [P, page_size, H, Dh], block_table [B, M],
+    index [B] int32) — the kernel is the hardware-speed formulation:
+    no materialized gathered window, fused window trim (dead pages
+    skipped dynamically), one compile per chunk SHAPE instead of one
+    per static window."""
+    b, s, h, d = q.shape
+    page_size = pool_k.shape[1]
+    m_pages = block_table.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    qh = jnp.swapaxes(q, 1, 2)                       # [B, H, S, D]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, m_pages),
+        in_specs=[
+            pl.BlockSpec((None, None, s, d),
+                         lambda b_, h_, j, tbl, idx: (b_, h_, 0, 0)),
+            pl.BlockSpec((None, page_size, None, d),
+                         lambda b_, h_, j, tbl, idx: (tbl[b_, j], 0, h_, 0)),
+            pl.BlockSpec((None, page_size, None, d),
+                         lambda b_, h_, j, tbl, idx: (tbl[b_, j], 0, h_, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, s, d),
+                               lambda b_, h_, j, tbl, idx: (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s, d), jnp.float32),
+            pltpu.VMEM((s, 1), jnp.float32),
+            pltpu.VMEM((s, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale,
+                          page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(block_table, jnp.int32), jnp.asarray(index, jnp.int32),
+      qh, pool_k, pool_v)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def paged_flash_decode_reference(q, pool_k, pool_v, block_table, index, *,
+                                 scale=None):
+    """Plain-JAX page-by-page accumulation — the kernel's portable
+    oracle, the same role ops.blockwise plays for the flash kernels:
+    identical math (bw.block_accumulate per page, sequential page
+    order).  Dead pages are accumulated under a fully-masked bias
+    rather than skipped — numerically inert by the NEG_INF
+    construction (p underflows to exactly 0, corr is exactly 1) — so
+    the only divergence from the kernel is XLA's batched-vs-per-
+    program einsum reduction order: float-ulp level, pinned by the
+    tests at 1e-6 alongside argmax equality."""
+    b, s, h, d = q.shape
+    page_size = pool_k.shape[1]
+    m_pages = block_table.shape[1]
+    scale = float(scale) if scale is not None else 1.0 / (d ** 0.5)
+    qh = jnp.swapaxes(q, 1, 2)                       # [B, H, S, D]
+    o = jnp.zeros(qh.shape, jnp.float32)
+    m = jnp.full((b, h, s), bw.NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    qpos = index[:, None, None, None] + jnp.arange(
+        s, dtype=jnp.int32)[None, None, :, None]     # [B, 1, S, 1]
+    for j in range(m_pages):
+        k = jnp.swapaxes(pool_k[block_table[:, j]], 1, 2)  # [B, H, P, D]
+        v = jnp.swapaxes(pool_v[block_table[:, j]], 1, 2)
+        kpos = (j * page_size + jnp.arange(page_size, dtype=jnp.int32)
+                )[None, None, None, :]               # [1, 1, 1, P]
+        bias = jnp.where(kpos <= qpos, 0.0, bw.NEG_INF)
+        o, m, l = bw.block_accumulate(o, m, l, qh, k, v, scale, bias)
+    return jnp.swapaxes(bw.finalize(o, l).astype(q.dtype), 1, 2)
+
+
+def paged_attention_auto(q, pool_k, pool_v, block_table, index, *,
+                         window_pages=None, use_pallas=None):
+    """Dispatch between the kernel and the gather oracle.
+
+    ``use_pallas``: None = auto (kernel on TPU — the default-on flag —
+    gather elsewhere); True = kernel; "interpret" = kernel through the
+    Pallas interpreter (CPU kernel validation); False = gather.
+    ``window_pages`` (static) trims the GATHER path's window exactly as
+    before; the kernel ignores it — its dynamic live predicate skips
+    the same pages without a per-window recompile."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        return paged_flash_decode(q, pool_k, pool_v, block_table, index,
+                                  interpret=use_pallas == "interpret")
+    table = (block_table if window_pages is None
+             else block_table[:, :window_pages])
+    return paged_attention(q, pool_k, pool_v, table, index)

@@ -1,0 +1,136 @@
+"""Ranked-lattice memoization: a JSON sidecar keyed by
+(workload, mesh descriptor, batch) so repeated ``--plan auto`` resolves
+skip the search.
+
+The lattice for a big simulated pod is cheap but not free (hundreds of
+plans × an analytic predict each), and plan resolution sits at the top
+of EVERY planned run — launcher restarts included.  The cache stores
+the full ranked artifact per key, so a hit reconstructs the exact
+RankedPlan list the search would have produced (same objects the
+ranking table, ``--out`` artifact, and ``--plan auto`` pick consume).
+
+Key = sha1 over everything that determines the ranking: the cache
+format version, the workload fingerprint (model name, family, seq_len,
+EXACT param count — a registry edit that changes the model changes the
+key), the full mesh descriptor dict, the global batch, the optimizer,
+and the HBM fraction.  Anything else (a cost-model change) bumps
+``CACHE_VERSION`` to invalidate wholesale.
+
+Corrupt or unreadable sidecars degrade to a recompute with a warning —
+a cache must never be able to fail a run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+from typing import List, Optional, Tuple
+
+from dtf_tpu.plan.cost_model import HBM_FRACTION, Plan, PlanCost
+from dtf_tpu.plan.mesh_spec import MeshSpec
+from dtf_tpu.plan.model_stats import ModelStats
+from dtf_tpu.plan.search import RankedPlan, search
+
+log = logging.getLogger("dtf_tpu")
+
+# bump when the ranking function changes (cost model, lattice, sort
+# order) — stale entries must not resurrect an old ranking
+CACHE_VERSION = 1
+
+
+def cache_key(stats: ModelStats, mesh: MeshSpec, global_batch: int,
+              optimizer: str, hbm_fraction: float = HBM_FRACTION
+              ) -> Tuple[str, dict]:
+    """(sha1 hex key, the human-readable payload stored beside it)."""
+    payload = {
+        "cache_version": CACHE_VERSION,
+        "model": stats.model,
+        "family": stats.family,
+        "seq_len": stats.seq_len,
+        "params": stats.params,
+        "mesh": mesh.to_dict(),
+        "global_batch": int(global_batch),
+        "optimizer": optimizer,
+        "hbm_fraction": hbm_fraction,
+    }
+    blob = json.dumps(payload, sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest(), payload
+
+
+def _ranked_from_dict(d: dict) -> RankedPlan:
+    pred = dict(d["predicted"])
+    pred.pop("feasible", None)            # a property, not a field
+    return RankedPlan(plan=Plan.from_dict(d["plan"]),
+                      cost=PlanCost(**pred),
+                      violations=tuple(d.get("violations", ())))
+
+
+def load_ranking(path: str, key: str) -> Optional[List[RankedPlan]]:
+    """The cached ranking for ``key``, or None (miss / unreadable —
+    unreadable warns and recomputes, it never raises)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        entry = doc.get("entries", {}).get(key)
+        if entry is None:
+            return None
+        return [_ranked_from_dict(r) for r in entry["ranked"]]
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        log.warning("plan cache %s unreadable (%s: %s) — recomputing",
+                    path, type(e).__name__, e)
+        return None
+
+
+def store_ranking(path: str, key: str, payload: dict,
+                  ranked: List[RankedPlan]) -> None:
+    """Merge one entry into the sidecar (atomic rename — two racing
+    plan resolves at worst each write a complete file).  Write failures
+    warn and continue: the ranking is already in hand."""
+    try:
+        doc = {"cache_version": CACHE_VERSION, "entries": {}}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    existing = json.load(f)
+                if existing.get("cache_version") == CACHE_VERSION:
+                    doc = existing
+            except (OSError, ValueError):
+                pass                      # overwrite the corrupt file
+        doc.setdefault("entries", {})[key] = {
+            "workload": payload,
+            "ranked": [r.to_dict() for r in ranked],
+        }
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    except OSError as e:
+        log.warning("plan cache %s not writable (%s) — search result "
+                    "still used, just not memoized", path, e)
+
+
+def cached_search(path: str, stats: ModelStats, mesh: MeshSpec,
+                  global_batch: int, optimizer: str = "sgd"
+                  ) -> Tuple[List[RankedPlan], bool]:
+    """search() through the sidecar: (ranked, was_a_hit)."""
+    key, payload = cache_key(stats, mesh, global_batch, optimizer)
+    cached = load_ranking(path, key)
+    if cached is not None:
+        log.info("plan cache hit (%s, %s, batch %d) — search skipped",
+                 stats.model, mesh.name, global_batch)
+        return cached, True
+    ranked = search(stats, mesh, global_batch, optimizer=optimizer)
+    store_ranking(path, key, payload, ranked)
+    return ranked, False

@@ -171,8 +171,19 @@ def resolve_plan(cfg, mesh: Optional[MeshSpec] = None):
             "a global truncation otherwise) — pass an explicit "
             "--plan_mesh descriptor instead")
     if cfg.plan == "auto":
-        ranked = best_plan(stats, mesh, cfg.batch_size,
-                           optimizer=cfg.optimizer)
+        if cfg.plan_cache:
+            # memoized lattice: launcher restarts and repeated resolves
+            # skip the search; the pick + loud-failure logic is shared
+            from dtf_tpu.plan.cache import cached_search
+            from dtf_tpu.plan.search import best_from_ranked
+            ranked_list, _ = cached_search(
+                cfg.plan_cache, stats, mesh, cfg.batch_size,
+                optimizer=cfg.optimizer)
+            ranked = best_from_ranked(ranked_list, stats, mesh,
+                                      cfg.batch_size)
+        else:
+            ranked = best_plan(stats, mesh, cfg.batch_size,
+                               optimizer=cfg.optimizer)
         plan, cost = ranked.plan, ranked.cost
         log.info(
             "plan auto (%s, %d devices): %s — predicted %.1f ms/step, "

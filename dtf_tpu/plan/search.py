@@ -116,7 +116,16 @@ def best_plan(stats: ModelStats, mesh: MeshSpec, global_batch: int,
     """The `--plan auto` resolution: the fastest feasible plan, or a
     loud error naming the smallest predicted overage when nothing
     fits."""
-    ranked = search(stats, mesh, global_batch, optimizer=optimizer)
+    return best_from_ranked(search(stats, mesh, global_batch,
+                                   optimizer=optimizer),
+                            stats, mesh, global_batch)
+
+
+def best_from_ranked(ranked: List[RankedPlan], stats: ModelStats,
+                     mesh: MeshSpec, global_batch: int) -> RankedPlan:
+    """best_plan over an already-ranked lattice (the plan-cache path
+    feeds memoized rankings through the same pick + loud-failure
+    logic)."""
     for r in ranked:
         if r.feasible:
             return r

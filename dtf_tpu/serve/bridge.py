@@ -8,15 +8,23 @@ Train-side state comes in two on-disk shapes (train/checkpoint.py):
   <export_dir>/model/              — inference variables only
       (params + batch_stats), the --export_dir SavedModel equivalent
 
-Serving needs neither optimizer state nor the step counter, and it
-needs FULL (un-sharded) parameter arrays on the serving device.  Both
+Serving needs neither optimizer state nor the step counter.  Params
 come out of orbax as host-global arrays regardless of how the run was
 sharded — a ZeRO run (--optimizer_sharding) slices only its *optimizer*
 state across 'data', and a TP/EP/PP run's params are saved as global
-arrays with per-leaf shardings — so the re-gather is: restore the
-global view, drop everything but params/batch_stats, and device_put the
-result with the replicated sharding of a fresh serving mesh
-(runtime/mesh.py ``make_mesh`` + ``NamedSharding(mesh, P())``).
+arrays with per-leaf shardings — so placement is one decision per
+serving deployment:
+
+  model_parallelism == 1 — device_put the restored tree with the
+      replicated sharding of a fresh 1-chip serving mesh (the original
+      restore-then-rebroadcast contract).
+  model_parallelism N — build an N-chip serving mesh ('model' axis =
+      N) and device_put each leaf DIRECTLY into the Megatron layout
+      (``param_partition_specs``: heads/ff column-parallel, out/fc2
+      row-parallel, everything else replicated).  The host-global
+      restore goes straight to its shards — no replicated on-device
+      intermediate, so a model that trains sharded loads for serving
+      without ever needing to fit on one chip.
 """
 
 from __future__ import annotations
@@ -28,6 +36,21 @@ from typing import Optional
 import jax
 
 log = logging.getLogger("dtf_tpu")
+
+
+def serving_mesh(model_parallelism: int = 1, devices=None):
+    """A serving mesh: ``model_parallelism`` devices on the 'model'
+    axis (data = seq = 1 — serving data parallelism is replica
+    processes, not a mesh axis)."""
+    from dtf_tpu.runtime.mesh import make_mesh
+
+    mp = max(int(model_parallelism), 1)
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < mp:
+        raise ValueError(
+            f"serving model_parallelism {mp} needs {mp} devices, "
+            f"{len(devices)} attached")
+    return make_mesh(devices[:mp], data=1, seq=1, model=mp)
 
 
 def load_inference_variables(model_dir: str = "", export_dir: str = "",
@@ -57,30 +80,67 @@ def load_inference_variables(model_dir: str = "", export_dir: str = "",
         f"model/, model_dir={model_dir!r} has no checkpoints/")
 
 
-def place_for_serving(variables, devices=None):
-    """Re-gather + place: put the (host-global) inference variables on
-    the serving mesh, fully replicated — the broadcast half of the
-    restore-then-rebroadcast checkpoint contract, reused for serving."""
+def tp_param_shardings(params, mesh):
+    """(PartitionSpec tree, NamedSharding tree) of the Megatron serving
+    layout for a full param pytree — THE single definition both the
+    bridge's placement and the Decoder's shard_map in_specs consume, so
+    a layout change cannot silently diverge between them."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from dtf_tpu.runtime.mesh import make_mesh
+    from dtf_tpu.models.transformer import param_partition_specs
+    from dtf_tpu.runtime.mesh import MODEL_AXIS
 
-    devices = list(devices if devices is not None else jax.devices()[:1])
-    mesh = make_mesh(devices, data=1, seq=1, model=1)
-    return jax.device_put(variables, NamedSharding(mesh, P()))
+    specs = param_partition_specs(params, MODEL_AXIS)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    return specs, shardings
+
+
+def place_for_serving(variables, devices=None, mesh=None,
+                      model_parallelism: int = 1):
+    """Place the (host-global) inference variables on the serving mesh.
+
+    Replicated at ``model_parallelism`` 1 (the original contract);
+    otherwise each params leaf goes DIRECTLY to its tensor-parallel
+    shard per ``param_partition_specs`` — train/export/ZeRO
+    checkpoints restore into the sharded layout with no replicated
+    intermediate.  ``mesh`` overrides the mesh construction (the
+    engine and the bridge must agree on one)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dtf_tpu.runtime.mesh import MODEL_AXIS, make_mesh
+
+    if mesh is None:
+        if model_parallelism > 1:
+            mesh = serving_mesh(model_parallelism, devices)
+        else:
+            devices = list(devices if devices is not None
+                           else jax.devices()[:1])
+            mesh = make_mesh(devices, data=1, seq=1, model=1)
+    mp = int(mesh.shape[MODEL_AXIS])
+    if mp <= 1:
+        return jax.device_put(variables, NamedSharding(mesh, P()))
+    replicated = NamedSharding(mesh, P())
+    shardings = {k: (tp_param_shardings(v, mesh)[1] if k == "params"
+                     else jax.tree_util.tree_map(lambda _: replicated, v))
+                 for k, v in variables.items()}
+    return jax.device_put(variables, shardings)
 
 
 def load_for_serving(model_dir: str = "", export_dir: str = "",
-                     step: Optional[int] = None, devices=None) -> dict:
-    """One-call bridge: restore + re-gather + place."""
+                     step: Optional[int] = None, devices=None, mesh=None,
+                     model_parallelism: int = 1) -> dict:
+    """One-call bridge: restore + place (replicated or TP-sharded)."""
     return place_for_serving(
         load_inference_variables(model_dir, export_dir, step=step),
-        devices=devices)
+        devices=devices, mesh=mesh, model_parallelism=model_parallelism)
 
 
 def serving_memory_plan(model, *, num_slots: int, max_seq_len: int,
                         kv_page_size: int = 0,
-                        kv_pool_pages: int = 0) -> dict:
+                        kv_pool_pages: int = 0,
+                        model_parallelism: int = 1) -> dict:
     """Byte accounting for a serving deployment: params + KV cache.
 
     The KV side is where the paged cache earns its keep: the contiguous
@@ -105,6 +165,7 @@ def serving_memory_plan(model, *, num_slots: int, max_seq_len: int,
     pool_pages = int(kv_pool_pages) or full_pages
     contiguous_tokens = num_slots * max_seq_len
     paged_tokens = (pool_pages - 1) * kv_page_size if kv_page_size else 0
+    mp = max(int(model_parallelism), 1)
     plan = {
         "per_token_kv_bytes": per_token,
         "kv_bytes_contiguous": contiguous_tokens * per_token,
@@ -112,12 +173,20 @@ def serving_memory_plan(model, *, num_slots: int, max_seq_len: int,
         "kv_tokens_capacity": paged_tokens or contiguous_tokens,
         "pages_per_slot": pages_per_slot if kv_page_size else 0,
         "pool_pages": pool_pages if kv_page_size else 0,
+        # TP shards the pool's HEAD dim: each of the mp chips holds
+        # 1/mp of every page (and of the params) — the lever that
+        # makes a too-big-for-one-chip model servable at all
+        "model_parallelism": mp,
+        "kv_bytes_per_device":
+            ((paged_tokens or contiguous_tokens) * per_token) // mp,
     }
     log.info(
         "serving memory plan: %d slots x %d tokens; KV contiguous %.1f "
-        "MB%s", num_slots, max_seq_len,
+        "MB%s%s", num_slots, max_seq_len,
         plan["kv_bytes_contiguous"] / 2**20,
         (f", paged pool {plan['kv_bytes_paged'] / 2**20:.1f} MB "
          f"({pool_pages} pages x {kv_page_size} tokens)"
-         if kv_page_size else " (paged cache off)"))
+         if kv_page_size else " (paged cache off)"),
+        (f", TP={mp}: {plan['kv_bytes_per_device'] / 2**20:.1f} "
+         f"MB KV/device" if mp > 1 else ""))
     return plan

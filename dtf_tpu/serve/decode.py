@@ -50,18 +50,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def make_decode_model(model, kv_page_size=None, kv_pool_pages=None):
+def make_decode_model(model, kv_page_size=None, kv_pool_pages=None,
+                      model_axis=None):
     """Clone a (training-configured) TransformerLM into decode mode.
 
-    Sharding attributes are stripped: decode is single-device (the
-    bridge re-gathers sharded checkpoints into full params first).
+    The seq axis is stripped (ring attention does not compose with the
+    KV cache); ``model_axis`` selects serving tensor parallelism —
+    None (the default) strips it for single-device decode, a mesh axis
+    name keeps Megatron head/ff sharding live (the Decoder then runs
+    the model inside shard_map with the KV pool's head dim sharded).
     Remat is stripped too — there is no backward pass to save memory
     for, and jax.checkpoint does not compose with the mutable cache.
     ``kv_page_size``/``kv_pool_pages`` select the paged cache layout."""
-    kw = {"decode": True}
-    for attr in ("seq_axis", "model_axis"):
-        if getattr(model, attr, None) is not None:
-            kw[attr] = None
+    kw = {"decode": True, "model_axis": model_axis}
+    if getattr(model, "seq_axis", None) is not None:
+        kw["seq_axis"] = None
     if getattr(model, "shard_vocab", False):
         kw["shard_vocab"] = False
     if getattr(model, "remat", False):
@@ -88,20 +91,26 @@ def init_cache(model, num_slots: int, max_seq_len: int):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
-def init_paged_cache(model, kv_page_size: int, kv_pool_pages: int):
-    """Zeros paged-cache pytree: a [kv_pool_pages, kv_page_size, H, Dh]
-    pool per layer per K/V.  Shapes come from an eval_shape of the
-    paged decode model's init (no params materialized)."""
+def paged_cache_shapes(model, kv_page_size: int, kv_pool_pages: int):
+    """ShapeDtypeStruct pytree of the paged cache: a
+    [kv_pool_pages, kv_page_size, H, Dh] pool per layer per K/V, from
+    an eval_shape of the paged decode model's init (no params — and no
+    cache — materialized)."""
     decode_model = make_decode_model(model, kv_page_size=kv_page_size,
                                      kv_pool_pages=kv_pool_pages)
     tokens = jax.ShapeDtypeStruct((1, kv_page_size), jnp.int32)
     idx = jax.ShapeDtypeStruct((1,), jnp.int32)
     table = jax.ShapeDtypeStruct((1, 1), jnp.int32)
-    shapes = jax.eval_shape(
+    return jax.eval_shape(
         functools.partial(decode_model.init, jax.random.key(0)),
         tokens, cache_index=idx, block_table=table)["cache"]
+
+
+def init_paged_cache(model, kv_page_size: int, kv_pool_pages: int):
+    """Zeros paged-cache pytree (single-device layout)."""
     return jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        paged_cache_shapes(model, kv_page_size, kv_pool_pages))
 
 
 def _sample(logits, temperature, key):
@@ -122,12 +131,26 @@ class Decoder:
     ``kv_page_size`` selects the paged cache (None = contiguous):
     ``kv_pool_pages`` TOTAL pool pages including the scratch page 0
     (None = full reservation, 1 + num_slots × pages-per-slot — the
-    engine shrinks it to provision for tokens in flight)."""
+    engine shrinks it to provision for tokens in flight).
+
+    ``mesh`` selects TENSOR-PARALLEL decode: a runtime mesh whose
+    'model' axis has size > 1.  Params shard per
+    ``param_partition_specs`` (heads/ff column-parallel, out/fc2
+    row-parallel) and each layer's KV page pool shards its HEAD dim —
+    every apply runs inside shard_map, tokens/block tables replicated,
+    logits replicated out (the last block exits through tp_psum).
+    Paged cache only: the page pool is the layout built for
+    production serving, and sharding the contiguous per-slot slabs
+    would buy nothing the pool doesn't."""
 
     def __init__(self, model, params, *, num_slots: int, max_seq_len: int,
                  kv_page_size: Optional[int] = None,
-                 kv_pool_pages: Optional[int] = None):
-        self.params = params
+                 kv_pool_pages: Optional[int] = None, mesh=None):
+        from dtf_tpu.runtime.mesh import MODEL_AXIS
+
+        self.mesh = mesh
+        self.tp = int(mesh.shape[MODEL_AXIS]) if mesh is not None else 1
+        self._model_axis = MODEL_AXIS if self.tp > 1 else None
         self.num_slots = int(num_slots)
         self.max_seq_len = int(max_seq_len)
         if getattr(model, "max_seq_len", max_seq_len) < max_seq_len:
@@ -135,6 +158,15 @@ class Decoder:
                 f"max_seq_len {max_seq_len} exceeds the model's position "
                 f"table ({model.max_seq_len})")
         self.paged = kv_page_size is not None
+        if self.tp > 1 and not self.paged:
+            raise ValueError(
+                "tensor-parallel decode needs the paged KV cache "
+                "(kv_page_size > 0) — the page pool is the layout that "
+                "shards")
+        if self.tp > 1 and model.num_heads % self.tp:
+            raise ValueError(
+                f"num_heads {model.num_heads} not divisible by the "
+                f"mesh's model axis ({self.tp})")
         if self.paged:
             self.page_size = int(kv_page_size)
             if self.page_size < 1:
@@ -149,28 +181,121 @@ class Decoder:
                     f"page), got {self.pool_pages}")
             self.model = make_decode_model(
                 model, kv_page_size=self.page_size,
-                kv_pool_pages=self.pool_pages)
-            # start / window_pages / flash_prefill are STATIC: they
-            # select the attention formulation and the gather extent,
-            # so the chunk body compiles once per (chunk shape, chunk
-            # index) — the "one compile per chunk shape" contract.
-            # What it buys: chunk c's attention gathers only the pages
-            # covering [0, start + C), so prefill work sums to
-            # O(prompt²/2) instead of chunks × the full window
+                kv_pool_pages=self.pool_pages,
+                model_axis=self._model_axis)
+            if self.tp > 1:
+                params = self._shard_params(params)
+            # window_pages / flash_prefill are STATIC (they select the
+            # attention formulation and the gather extent); start is
+            # TRACED.  Gather path: window_pages = the chunk's visible
+            # pages → one compile per (chunk shape, window), buying the
+            # O(prompt²/2) static trim.  Kernel path: the kernel trims
+            # dynamically (pl.when dead-page skip), so prefill_chunk
+            # passes window_pages=None and the body compiles ONCE per
+            # chunk shape — the per-chunk-index compile storm is gone,
+            # not just the gather
             self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1,),
-                                  static_argnums=(7, 8, 9))
+                                  static_argnums=(8, 9))
+            up = getattr(self.model, "use_pallas", None)
+            self._kernel_attn = bool(
+                up if up is not None
+                else jax.default_backend() == "tpu")
             self._decode = jax.jit(self._decode_paged_impl,
                                    donate_argnums=(1,))
+            # COW page copy (engine prefix sharing): one whole
+            # [page_size, H, Dh] row per layer per K/V — page dim is
+            # unsharded, so the copy is shard-local under TP too
+            self._copy_page = jax.jit(
+                lambda cache, src, dst: jax.tree_util.tree_map(
+                    lambda c: c.at[dst].set(c[src]), cache),
+                donate_argnums=(0,))
         else:
             self.model = make_decode_model(model)
             self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
             self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self.params = params
+
+    # -- tensor-parallel plumbing --------------------------------------
+    def _shard_params(self, params):
+        """Place a full param tree into the Megatron layout on the
+        mesh — one host→shard transfer per leaf, no replicated
+        intermediate.  The layout definition is the bridge's
+        (tp_param_shardings — one source for placement AND the
+        shard_map in_specs kept here)."""
+        from dtf_tpu.serve.bridge import tp_param_shardings
+
+        self._pspecs, shardings = tp_param_shardings(params, self.mesh)
+        return jax.device_put(params, shardings)
+
+    def _cache_pspec(self):
+        # KV pool sharding: [pool_pages, page_size, H, Dh] splits H
+        from jax.sharding import PartitionSpec as P
+        return P(None, None, self._model_axis, None)
+
+    def _apply_model(self, params, cache, tokens, index, block_table,
+                     flash_prefill, window_pages):
+        """model.apply with mutable cache — direct on one device,
+        shard_mapped over the mesh under TP (tokens/index/tables
+        replicated in, logits replicated out, cache specs on the pool
+        head dim; flash_prefill/window_pages are trace-time statics
+        closed over)."""
+        if self.tp == 1:
+            return self.model.apply(
+                {"params": params, "cache": cache}, tokens,
+                cache_index=index, block_table=block_table,
+                flash_prefill=flash_prefill, window_pages=window_pages,
+                mutable=["cache"])
+        from jax.sharding import PartitionSpec as P
+
+        cspec = jax.tree_util.tree_map(lambda _: self._cache_pspec(),
+                                       cache)
+
+        def body(p, c, t, i, bt):
+            return self.model.apply(
+                {"params": p, "cache": c}, t, cache_index=i,
+                block_table=bt, flash_prefill=flash_prefill,
+                window_pages=window_pages, mutable=["cache"])
+
+        return jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(self._pspecs, cspec, P(), P(), P()),
+            out_specs=(P(), {"cache": cspec}),
+            check_vma=False)(params, cache, tokens, index, block_table)
 
     def fresh_cache(self):
         if self.paged:
+            if self.tp > 1:
+                # global-shaped zeros (full head count) created
+                # DIRECTLY sharded on the pool head dim via jit
+                # out_shardings — each device materializes only its
+                # own shard.  A replicated zeros-then-device_put would
+                # allocate the FULL pool on one chip first, the exact
+                # never-fits-on-one-chip trap the sharded params
+                # restore avoids.  Shapes come from a single-device
+                # clone because the TP model's init cannot trace
+                # outside shard_map (unbound axis)
+                from jax.sharding import NamedSharding
+
+                base = self.model.clone(model_axis=None)
+                shapes = paged_cache_shapes(base, self.page_size,
+                                            self.pool_pages)
+                sharding = NamedSharding(self.mesh, self._cache_pspec())
+                return jax.jit(
+                    lambda: jax.tree_util.tree_map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), shapes),
+                    out_shardings=jax.tree_util.tree_map(
+                        lambda _: sharding, shapes))()
             return init_paged_cache(self.model, self.page_size,
                                     self.pool_pages)
         return init_cache(self.model, self.num_slots, self.max_seq_len)
+
+    def copy_page(self, cache, src: int, dst: int):
+        """Physically copy pool page ``src`` onto ``dst`` in every
+        layer's K and V pool — the engine's copy-on-write primitive
+        (prefix sharing: a shared page about to be written is copied
+        onto a fresh page first)."""
+        return self._copy_page(cache, jnp.asarray(src, jnp.int32),
+                               jnp.asarray(dst, jnp.int32))
 
     # -- jitted bodies -------------------------------------------------
     def _prefill_impl(self, params, cache, tokens, slot, length,
@@ -215,15 +340,15 @@ class Decoder:
         scalar (offset WITHIN the chunk of the last real prompt token —
         only read on the final chunk; earlier chunks' sampled token is
         discarded by the engine).  ``start`` (the chunk's first logical
-        position), ``window_pages`` (pages covering [0, start + C)) and
+        position) is a traced scalar; ``window_pages`` (pages covering
+        [0, start + C), gather path — None under the kernel) and
         ``flash_prefill`` (start == 0: causal-only via the flash
         kernel) are static.  Returns (token, cache, sampled-position
         logits)."""
-        logits, mut = self.model.apply(
-            {"params": params, "cache": cache}, tokens,
-            cache_index=jnp.full((1,), start, jnp.int32),
-            block_table=block_row, flash_prefill=flash_prefill,
-            window_pages=window_pages, mutable=["cache"])
+        logits, mut = self._apply_model(
+            params, cache, tokens,
+            jnp.broadcast_to(jnp.asarray(start, jnp.int32), (1,)),
+            block_row, flash_prefill, window_pages)
         last = jax.lax.dynamic_slice_in_dim(
             logits[0], sample_pos, 1, axis=0)[0]           # [V]
         tok = _sample(last, temperature, key)
@@ -235,10 +360,8 @@ class Decoder:
         decode phase carry an ALL-ZEROS block row, steering their
         garbage write/gather at the scratch page (ops.paged_attention).
         """
-        logits, mut = self.model.apply(
-            {"params": params, "cache": cache}, tokens,
-            cache_index=index, block_table=block_tables,
-            mutable=["cache"])
+        logits, mut = self._apply_model(
+            params, cache, tokens, index, block_tables, False, None)
         last = logits[:, -1]                               # [B, V]
         keys = jax.random.split(key, last.shape[0])
         toks = jax.vmap(_sample)(last, temperature, keys)
@@ -286,12 +409,18 @@ class Decoder:
                 f"must be page-aligned (kv_page_size {self.page_size}) — "
                 f"whole-page writes depend on it")
         block_row = np.asarray(block_row, np.int32).reshape(1, -1)
-        window = (int(start) + chunk.shape[1]) // self.page_size
+        # gather path: static window trim (one compile per window, the
+        # O(prompt²/2) contract); kernel path: None — the kernel skips
+        # dead pages dynamically, so every chunk index shares ONE
+        # compile per chunk shape
+        window = (None if self._kernel_attn
+                  else (int(start) + chunk.shape[1]) // self.page_size)
         return self._chunk(self.params, cache, jnp.asarray(chunk),
                            jnp.asarray(block_row),
                            jnp.asarray(sample_pos, jnp.int32),
                            jnp.asarray(temperature, jnp.float32), key,
-                           int(start), window, start == 0)
+                           jnp.asarray(int(start), jnp.int32), window,
+                           start == 0)
 
     def decode_step(self, cache, tokens, index, temperature, key,
                     block_tables=None):

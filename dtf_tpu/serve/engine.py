@@ -42,6 +42,44 @@ production levers land:
       through the flash kernel (no cache gather at all), so short
       prompts — the common case — never touch the gather path.
 
+With prefix sharing (on by default in paged mode) the pool pages are
+REFCOUNTED and a registry keyed by token-id hash maps every request's
+full prompt-prefix pages to their physical pages:
+
+  prefix hits — an admitted prompt whose leading full pages match a
+      registered prefix (verified against the stored token ids — a
+      hash collision degrades to a miss, never a wrong share) SHARES
+      those physical pages instead of allocating + prefilling them: a
+      common system prompt costs ONE physical copy across the whole
+      batch, and admission needs only the unshared tail's pages.
+      The registry OWNS one holder per registered page (cache
+      semantics), so a warm prefix survives its requests retiring;
+      when admission starves for pages, registry-only pages are
+      EVICTED deepest-first (so surviving shallower entries stay a
+      valid chain) until the admit fits — cached prefixes never
+      block live traffic.
+  copy-on-write — shared pages are never written.  The one write that
+      can target a shared page (a prompt that is ENTIRELY a registered
+      prefix must still re-decode its last token for the first-token
+      logits) copies the page onto a fresh one first
+      (``Decoder.copy_page``), then diverges there.
+  release on retire — refcounts drop at retire; a page returns to the
+      free list (and its registry entry is dropped) only when the last
+      holder releases it.
+
+Token STREAMING: every handle exposes ``stream()`` — an iterator
+yielding each generated token as its decode step retires, and
+``submit(on_token=...)`` — a per-token callback from the engine thread.
+First-token latency is then one decode step after prefill, not the
+whole generation; the ``serve_stream_lag_s`` histogram records how far
+consumers run behind the engine.
+
+Tensor-parallel decode: pass ``mesh`` (runtime/mesh, 'model' axis = N)
+and the decoder runs every prefill/decode under shard_map with params
+and the KV page pool sharded over the axis (serve/decode.py).  The
+engine's host-side logic — slots, pages, scheduling — is unchanged:
+block tables are replicated, sharding is the decoder's concern.
+
 Single engine thread owns ALL device work (prefill, decode, sampling);
 ``submit`` only enqueues — so there is no cross-thread jit contention.
 Each decode step syncs the sampled tokens to the host (the EOS/budget
@@ -52,11 +90,13 @@ serving stack the next optimization would be a lookahead pipeline.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import logging
+import queue as queue_mod
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -108,12 +148,22 @@ class ServeResult:
 
 
 class _Handle:
-    """Future-lite returned by submit()."""
+    """Future-lite returned by submit() — plus a token stream.
 
-    def __init__(self, req: ServeRequest):
+    ``result()`` is the retire-granular view (all tokens at once);
+    ``stream()`` yields each token as its decode step retires, so a
+    client renders output at first-token latency instead of
+    full-generation latency.  Both views see the same tokens."""
+
+    def __init__(self, req: ServeRequest,
+                 on_token: Optional[Callable] = None,
+                 stream_lag_hist=None):
         self.request = req
         self._event = threading.Event()
         self._result: Optional[ServeResult] = None
+        self._on_token = on_token
+        self._lag_hist = stream_lag_hist
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -124,20 +174,60 @@ class _Handle:
                 f"request {self.request.id} not finished in {timeout}s")
         return self._result
 
+    def stream(self, timeout: Optional[float] = None):
+        """Iterator over generated tokens, yielding as each retires
+        from a decode step.  ``timeout`` bounds the wait for EACH
+        token (TimeoutError past it).  Ends when the request finishes
+        (or is cancelled — check ``result().cancelled``).  Observes
+        the engine's ``serve_stream_lag_s`` histogram: time from the
+        engine emitting a token to the consumer receiving it — the
+        slow-consumer signal."""
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    f"request {self.request.id}: no token in {timeout}s"
+                ) from None
+            if kind == "done":
+                return
+            tok, t_emit = payload
+            if self._lag_hist is not None:
+                self._lag_hist.observe(max(0.0, time.time() - t_emit))
+            yield tok
+
+    def _emit(self, token: int):
+        """Engine thread: one token retired."""
+        self._q.put(("token", (int(token), time.time())))
+        if self._on_token is not None:
+            try:
+                self._on_token(int(token))
+            except Exception:  # noqa: BLE001 — a client callback must
+                # never take down the engine thread
+                log.exception("serve: on_token callback raised")
+
     def _deliver(self, result: ServeResult):
         self._result = result
         self._event.set()
+        self._q.put(("done", None))
 
 
 class PagePool:
-    """Host-side free-list allocator over the shared KV page pool.
+    """Host-side REFCOUNTED free-list allocator over the shared KV
+    page pool.
 
     Page 0 is the SCRATCH page — never handed to a request.  Inactive
     rows of the fixed-shape decode batch carry all-zeros block-table
     rows, so their garbage writes/gathers land there and can never
     touch a live sequence (ops.paged_attention has the full invariant).
-    ``high_water`` records the peak pages in use — the number that
-    proves retired pages are actually reclaimed and reused."""
+
+    Refcounts carry prefix sharing: ``alloc`` grants fresh pages at
+    refcount 1, ``share`` adds a holder to a live page, and ``free``
+    releases one holder — a page physically returns to the free list
+    only when its LAST holder releases it.  ``high_water`` records the
+    peak physical pages in use — the number that proves both that
+    retired pages are reclaimed AND that shared prefixes really cost
+    one physical copy."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -148,6 +238,7 @@ class PagePool:
         # next admit — maximally warm reuse, and the reclamation tests
         # can assert the high-water mark stays at the concurrent need
         self._free = list(range(self.num_pages - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
         self.high_water = 0
 
     @property
@@ -162,17 +253,152 @@ class PagePool:
     def used_pages(self) -> int:
         return self.usable_pages - len(self._free)
 
+    @property
+    def shared_refs(self) -> int:
+        """Extra holders beyond the first across all pages — how many
+        page allocations prefix sharing is currently saving."""
+        return sum(c - 1 for c in self._ref.values() if c > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n pages, or None when the pool cannot cover them (caller
-        waits for a retire — never a partial grant)."""
+        """n fresh pages at refcount 1, or None when the pool cannot
+        cover them (caller waits for a retire — never a partial
+        grant)."""
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
         self.high_water = max(self.high_water, self.used_pages)
         return pages
 
-    def free(self, pages: List[int]):
-        self._free.extend(pages)
+    def share(self, pages: List[int]):
+        """Add one holder to each (live) page — the prefix-hit grant."""
+        for p in pages:
+            if self._ref.get(p, 0) < 1:
+                raise ValueError(
+                    f"page {p} is not allocated — sharing a freed page "
+                    f"would alias a future grant")
+            self._ref[p] += 1
+
+    def free(self, pages: List[int]) -> List[int]:
+        """Release one holder per page; pages whose last holder left
+        return to the free list.  Returns the PHYSICALLY freed pages
+        (the engine drops their prefix-registry entries)."""
+        freed: List[int] = []
+        for p in pages:
+            c = self._ref.get(p, 0)
+            if c < 1:
+                raise ValueError(f"double free of page {p}")
+            if c == 1:
+                del self._ref[p]
+                self._free.append(p)
+                freed.append(p)
+            else:
+                self._ref[p] = c - 1
+        return freed
+
+
+def _page_digest(prev: str, page_tokens: np.ndarray) -> str:
+    """Chained content key: depth-d digest = sha1(depth-(d−1) digest ‖
+    page d's int32 token bytes).  Chaining makes the whole registry
+    walk O(pages) — hashing the full growing prefix at every depth
+    would be O(pages²·page_size) sha1 bytes per admission attempt, on
+    the engine thread, repeated while a starved head-of-line request
+    waits.  Collisions are astronomically unlikely, and the registry
+    verifies the stored page tokens on every hit anyway (module-level
+    so tests can monkeypatch a colliding hash and pin the guard)."""
+    return hashlib.sha1(
+        prev.encode()
+        + np.ascontiguousarray(page_tokens, np.int32).tobytes()
+    ).hexdigest()
+
+
+class PrefixRegistry:
+    """Token-id-hash → physical-page map for FULL prompt-prefix pages.
+
+    Entry at depth d maps the CHAINED digest of
+    ``prompt[: (d+1)·page_size]`` (depth-d digest = sha1(depth-(d−1)
+    digest ‖ page d's tokens) — same information as hashing the full
+    prefix, at O(pages) total work) to the physical page holding
+    positions [d·ps, (d+1)·ps) of that prefix — valid because KV
+    content is a pure function of (token ids, absolute positions), and
+    prefix pages are position-aligned by construction.  Entries are OWNING (cache semantics): the engine
+    registers a request's prefix pages when its prefill completes and
+    the registry takes one pool holder per newly-registered page — a
+    warm prefix outlives the request that wrote it.  Later admits
+    share entries (refcount++), and an entry dies two ways: the pool
+    physically frees the page (``drop_page``), or the engine EVICTS it
+    to un-starve admission (deepest-first; only pages whose sole
+    holder is the registry).  Lookup walks depths 0, 1, ... and stops
+    at the first miss (prefix property) or at the first stored-token
+    mismatch (the hash-collision guard: a colliding digest degrades to
+    a miss, never to serving another prompt's KV)."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        # (depth, chain digest) -> (physical page, THAT page's token
+        # bytes).  Storing only the page's own tokens suffices: lookup
+        # walks from depth 0, so when every ancestor's stored block
+        # already matched, matching this block proves the full prefix
+        # by induction — O(pages) storage and verification
+        self._entries: Dict[Tuple[int, str], Tuple[int, bytes]] = {}
+        self._by_page: Dict[int, Tuple[int, str]] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, prompt: np.ndarray) -> List[int]:
+        """Longest registered chain of the prompt's full pages —
+        pages[d] holds positions [d·ps, (d+1)·ps)."""
+        ps = self.page_size
+        pages: List[int] = []
+        digest = ""
+        for depth in range(int(len(prompt)) // ps):
+            block = np.ascontiguousarray(
+                prompt[depth * ps: (depth + 1) * ps], np.int32)
+            digest = _page_digest(digest, block)
+            ent = self._entries.get((depth, digest))
+            if ent is None or ent[1] != block.tobytes():
+                break
+            pages.append(ent[0])
+        return pages
+
+    def register(self, prompt: np.ndarray, pages: List[int]) -> List[int]:
+        """Record a request's full prompt pages (pages[d] = physical
+        page of depth d).  First writer wins per key; a page backs at
+        most one entry.  Returns the NEWLY registered pages — the
+        engine gives the registry one pool holder for exactly those."""
+        ps = self.page_size
+        fresh: List[int] = []
+        digest = ""
+        for depth, page in enumerate(pages):
+            block = np.ascontiguousarray(
+                prompt[depth * ps: (depth + 1) * ps], np.int32)
+            digest = _page_digest(digest, block)
+            key = (depth, digest)
+            if key in self._entries or page in self._by_page:
+                continue
+            self._entries[key] = (page, block.tobytes())
+            self._by_page[page] = key
+            fresh.append(page)
+        return fresh
+
+    def pages_by_depth_desc(self) -> List[int]:
+        """All registered pages, deepest entries first — the eviction
+        scan order (evicting depth d+1 before d keeps every surviving
+        chain contiguous from depth 0, which is all lookup can use)."""
+        return [page for (depth, _), (page, _) in sorted(
+            self._entries.items(), key=lambda kv: -kv[0][0])]
+
+    def drop_page(self, page: int):
+        """The pool physically freed this page — its content is about
+        to be someone else's."""
+        key = self._by_page.pop(page, None)
+        if key is not None:
+            self._entries.pop(key, None)
 
 
 @dataclasses.dataclass
@@ -204,14 +430,20 @@ class ServeEngine:
     for actual tokens in flight).  ``prefill_chunk`` is the chunked-
     prefill unit in tokens (multiple of the page size; 0 = whole
     prompts prefill as one page-aligned chunk; None = the default,
-    4 pages)."""
+    4 pages).
+
+    ``prefix_sharing`` (paged mode, default on) shares full
+    prompt-prefix pages across requests via the refcounted pool +
+    prefix registry (module docstring).  ``mesh`` selects
+    tensor-parallel decode (paged mode; serve/decode.py Decoder)."""
 
     def __init__(self, model, params, *, max_batch: int = 8,
                  max_seq_len: Optional[int] = None,
                  max_delay_s: float = 0.005, queue_size: int = 64,
                  seed: int = 0, kv_page_size: Optional[int] = 16,
                  kv_pool_pages: Optional[int] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 prefix_sharing: bool = True, mesh=None):
         if max_batch < 1 or queue_size < 1:
             raise ValueError("max_batch and queue_size must be >= 1")
         self.max_batch = int(max_batch)
@@ -235,9 +467,16 @@ class ServeEngine:
                 max_seq_len=self.max_seq_len,
                 kv_page_size=self.page_size,
                 kv_pool_pages=(int(kv_pool_pages) if kv_pool_pages
-                               else None))
+                               else None), mesh=mesh)
             self.pool = PagePool(self.decoder.pool_pages)
+            self.prefix_sharing = bool(prefix_sharing)
+            self.registry = PrefixRegistry(self.page_size)
         else:
+            if mesh is not None:
+                raise ValueError("tensor-parallel serving needs the "
+                                 "paged cache (kv_page_size > 0)")
+            self.prefix_sharing = False
+            self.registry = None
             # None is the only "unset" value — an explicit chunk size
             # (including 0) with the contiguous cache is a
             # contradiction, rejected loudly regardless of its value
@@ -297,6 +536,26 @@ class ServeEngine:
             "serve_prefill_chunks_total", unit="chunks")
         self._m_decode_gap = self.metrics.histogram("serve_decode_gap_s",
                                                     unit="s")
+        # per-axis decode metrics: the mesh's tensor-parallel ways and
+        # the decode-step time distribution — tokens/s-per-chip and
+        # TP-scaling come straight from these two
+        self._m_tp_ways = self.metrics.gauge("serve_tp_ways", unit="ways")
+        self._m_tp_ways.set(getattr(self.decoder, "tp", 1))
+        self._m_step_time = self.metrics.histogram("serve_decode_step_s",
+                                                   unit="s")
+        # prefix sharing: pages shared instead of allocated, COW
+        # copies, and the live shared-holder count
+        self._m_prefix_hits = self.metrics.counter(
+            "serve_prefix_hit_pages_total", unit="pages")
+        self._m_cow = self.metrics.counter("serve_prefix_cow_total",
+                                           unit="pages")
+        self._m_evicted = self.metrics.counter(
+            "serve_prefix_evicted_total", unit="pages")
+        self._m_shared = self.metrics.gauge("serve_kv_pages_shared_refs",
+                                            unit="refs")
+        # streaming: engine-emit → consumer-receive delay per token
+        self._m_stream_lag = self.metrics.histogram("serve_stream_lag_s",
+                                                    unit="s")
         self._last_step_t: Optional[float] = None
         self._prefill_rr = -1           # round-robin cursor (chunk sched)
         self.max_concurrent = 0         # peak simultaneously-active slots
@@ -330,7 +589,12 @@ class ServeEngine:
     # -- client side ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> _Handle:
+               eos_id: Optional[int] = None,
+               on_token: Optional[Callable] = None) -> _Handle:
+        """Enqueue a request.  ``on_token`` is an optional per-token
+        callback invoked FROM THE ENGINE THREAD as each token retires
+        (keep it cheap — it sits on the decode path); the returned
+        handle's ``stream()`` is the pull-based alternative."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -355,7 +619,8 @@ class ServeEngine:
                     f"request")
         req = ServeRequest(prompt=prompt, max_new_tokens=int(max_new_tokens),
                            temperature=float(temperature), eos_id=eos_id)
-        handle = _Handle(req)
+        handle = _Handle(req, on_token=on_token,
+                        stream_lag_hist=self._m_stream_lag)
         with self._cond:
             # checked under the lock: a submit racing stop() must either
             # land in _pending BEFORE the stop (and get drained or
@@ -449,17 +714,33 @@ class ServeEngine:
                 admitted = []
                 for i, slot in enumerate(self._slots):
                     if slot is None and self._pending:
-                        pages = None
+                        grant = None
                         if self.paged:
                             req = self._pending[0].request
-                            need = self._pages_needed(req)
+                            shared, need, cow = self._admission_plan(req)
+                            # hold the shared pages BEFORE any alloc/
+                            # eviction: a registry-only page this admit
+                            # is about to share must not be evicted out
+                            # from under it
+                            self.pool.share(shared)
                             pages = self.pool.alloc(need)
+                            if pages is None:
+                                self._evict_for(need)
+                                pages = self.pool.alloc(need)
                             if pages is None:
                                 # head-of-line FIFO wait: the next
                                 # retire frees pages; small requests do
-                                # NOT slip past a starved big one
+                                # NOT slip past a starved big one.
+                                # Un-hold the speculative shares (the
+                                # registry's own holder keeps them
+                                # warm for the retry)
+                                for p in self.pool.free(shared):
+                                    self.registry.drop_page(p)
                                 break
-                        admitted.append((i, self._pending.pop(0), pages))
+                            if shared:
+                                self._m_prefix_hits.inc(len(shared))
+                            grant = (pages, shared, cow)
+                        admitted.append((i, self._pending.pop(0), grant))
                 self._m_queue_depth.set(len(self._pending))
             if self._stop.is_set() and not any(
                     s is not None for s in self._slots) and not admitted:
@@ -490,6 +771,7 @@ class ServeEngine:
             self._m_occupancy.set(active / self.max_batch)
             if self.paged:
                 self._m_pages_used.set(self.pool.used_pages)
+                self._m_shared.set(self.pool.shared_refs)
             if active:
                 self._m_occ_sampled.observe(active / self.max_batch)
                 self._m_queue_sampled.observe(len(self._pending))
@@ -502,6 +784,21 @@ class ServeEngine:
                 # head-of-line measurement
                 self._last_step_t = None
 
+    def _evict_for(self, need: int):
+        """Free registry-only pages (deepest entries first) until
+        ``need`` pages are free or nothing evictable remains — cached
+        prefixes yield to live traffic, never the other way around.
+        Pages any live slot still holds (refcount > 1) are skipped."""
+        if not self.prefix_sharing:
+            return
+        for page in self.registry.pages_by_depth_desc():
+            if self.pool.free_pages >= need:
+                return
+            if self.pool.refcount(page) == 1:
+                for p in self.pool.free([page]):
+                    self.registry.drop_page(p)
+                self._m_evicted.inc()
+
     def _pages_needed(self, req: ServeRequest) -> int:
         """Worst-case pages for a request: prompt + full budget.
         Reserving up front means a decode step can never OOM the pool
@@ -509,15 +806,45 @@ class ServeEngine:
         total = int(req.prompt.size) + int(req.max_new_tokens)
         return -(-total // self.page_size)
 
-    def _chunk_plan(self, plen: int):
-        """[(start, len), ...] page-aligned chunks covering the prompt.
+    def _admission_plan(self, req: ServeRequest):
+        """(shared pages, fresh pages needed, cow) for one request —
+        engine thread, under the lock.
+
+        ``shared``: the registry's longest verified chain of this
+        prompt's full prefix pages.  ``cow`` is True when the chain
+        covers the ENTIRE prompt (plen an exact page multiple, all its
+        pages registered): the slot then skips prefill and re-decodes
+        its last prompt token for the first-token logits — a write
+        into the last shared page, which therefore needs a fresh
+        copy-on-write target (+1 fresh page)."""
+        total_pages = self._pages_needed(req)
+        shared = (self.registry.lookup(req.prompt)
+                  if self.prefix_sharing else [])
+        cow = bool(shared) and len(shared) * self.page_size >= int(
+            req.prompt.size)
+        if cow and total_pages + 1 > self.pool.usable_pages:
+            # the COW target makes physical demand total_pages + 1 —
+            # past the submit guard's total_pages <= usable bound, so
+            # a request sized exactly to the pool would LIVELOCK here
+            # (its own share holds the chain above eviction's
+            # refcount-1 bar).  Degrade: drop the chain's last page
+            # and prefill it instead — demand is back to total_pages
+            shared = shared[:-1]
+            cow = False
+        need = total_pages - len(shared) + (1 if cow else 0)
+        return shared, need, cow
+
+    def _chunk_plan(self, plen: int, start: int = 0):
+        """[(start, len), ...] page-aligned chunks covering
+        [start, plen) of the prompt (``start`` — the first position
+        NOT covered by shared prefix pages — must be page-aligned).
         Full ``prefill_chunk``-token chunks, then one final chunk padded
         to the page size (so the final chunk always contains the last
         real prompt token — the sampled position).  prefill_chunk == 0:
-        the whole prompt is one page-aligned chunk."""
-        chunk = self.prefill_chunk or -(-plen // self.page_size) * \
-            self.page_size
-        plan, start = [], 0
+        the whole remainder is one page-aligned chunk."""
+        chunk = self.prefill_chunk or -(-(plen - start) //
+                                        self.page_size) * self.page_size
+        plan = []
         while plen - start > chunk:
             plan.append((start, chunk))
             start += chunk
@@ -525,8 +852,7 @@ class ServeEngine:
         plan.append((start, -(-rem // self.page_size) * self.page_size))
         return plan
 
-    def _admit(self, slot_idx: int, handle: _Handle,
-               pages: Optional[List[int]]):
+    def _admit(self, slot_idx: int, handle: _Handle, grant):
         req = handle.request
         req.admit_time = time.time()
         if not self.paged:
@@ -538,19 +864,52 @@ class ServeEngine:
             slot = _Slot(handle=handle, tokens=[first], last_token=first,
                          index=int(req.prompt.size))
             self._slots[slot_idx] = slot
+            handle._emit(first)
             if self._finished(slot):
                 self._retire(slot_idx)
             return
+        fresh, shared, cow = grant
         plen = int(req.prompt.size)
-        plan = self._chunk_plan(plen)
+        ps = self.page_size
+        fresh = list(fresh)
+        shared = list(shared)
+        if cow:
+            # the whole prompt is a registered prefix: the slot's only
+            # compute is re-decoding its last prompt token (for the
+            # first-token logits), and that WRITES position plen−1 —
+            # into the last shared page.  Copy-on-write: the write goes
+            # to a fresh physical copy; the original stays pristine for
+            # its other holders.
+            src = shared.pop()
+            dst = fresh.pop(0)
+            self._cache = self.decoder.copy_page(self._cache, src, dst)
+            self._m_cow.inc()
+            for p in self.pool.free([src]):   # release our share
+                self.registry.drop_page(p)
+            logical = shared + [dst] + fresh
+        else:
+            logical = shared + fresh
+        k = len(shared) + (1 if cow else 0)   # depths covered pre-prefill
+        block_row = np.zeros((self.decoder.pages_per_slot,), np.int32)
+        block_row[:len(logical)] = logical
+        # pages this slot must RELEASE at retire: one holder per page
+        # it sits on (shared pages decrement, fresh/COW pages free)
+        owned = logical
+        if cow:
+            # no prefill: straight to decode, replaying the last
+            # prompt token (its KV write lands in the COW page)
+            slot = _Slot(handle=handle, tokens=[],
+                         last_token=int(req.prompt[-1]), index=plen - 1,
+                         pages=owned, block_row=block_row)
+            self._slots[slot_idx] = slot
+            return
+        plan = self._chunk_plan(plen, start=k * ps)
         padded_len = plan[-1][0] + plan[-1][1]
         prompt_padded = np.zeros((padded_len,), np.int32)
         prompt_padded[:plen] = req.prompt
-        block_row = np.zeros((self.decoder.pages_per_slot,), np.int32)
-        block_row[:len(pages)] = pages
         self._slots[slot_idx] = _Slot(
             handle=handle, tokens=[], last_token=0, index=0,
-            phase="prefill", pages=pages, block_row=block_row,
+            phase="prefill", pages=owned, block_row=block_row,
             prompt_padded=prompt_padded, chunk_plan=plan, chunk_i=0)
 
     def _advance_prefill(self, slot_idx: int):
@@ -575,6 +934,18 @@ class ServeEngine:
             slot.last_token = first
             slot.index = plen
             slot.phase = "decode"
+            if self.prefix_sharing and plen // self.page_size:
+                # the slot's full prompt pages are now written and
+                # immutable (decode writes land past the prompt) —
+                # publish them so later admits with the same prefix
+                # share instead of re-prefilling.  The registry takes
+                # its own holder on each newly-registered page (cache
+                # semantics: the prefix survives this request's
+                # retire; eviction reclaims it under pool pressure)
+                self.pool.share(self.registry.register(
+                    req.prompt,
+                    [int(p) for p in slot.block_row[: plen // self.page_size]]))
+            slot.handle._emit(first)
             if self._finished(slot):
                 self._retire(slot_idx)
 
@@ -604,6 +975,7 @@ class ServeEngine:
                 self._cache, tokens, index, temps, sub,
                 block_tables=tables)
             out = np.asarray(out)
+        self._m_step_time.observe(time.perf_counter() - now)
         for i, s in enumerate(self._slots):
             if s is None or s.phase != "decode":
                 continue
@@ -611,6 +983,12 @@ class ServeEngine:
             s.tokens.append(tok)
             s.last_token = tok
             s.index += 1
+            req = s.handle.request
+            if req.first_token_time == 0.0:
+                # the COW fast path skips prefill entirely — its first
+                # token comes out of this decode step
+                req.first_token_time = time.time()
+            s.handle._emit(tok)
             if self._finished(s):
                 self._retire(i)
         self._last_step_t = time.perf_counter()
@@ -626,8 +1004,13 @@ class ServeEngine:
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         if slot.pages:
-            # reclaim: these exact pages are the next admit's grant
-            self.pool.free(slot.pages)
+            # reclaim: each page loses this slot's holder; pages whose
+            # LAST holder left return to the free list, and their
+            # prefix-registry entries die with them (the physical page
+            # is about to hold someone else's KV)
+            for p in self.pool.free(slot.pages):
+                if self.registry is not None:
+                    self.registry.drop_page(p)
         req = slot.handle.request
         req.finish_time = time.time()
         result = ServeResult(

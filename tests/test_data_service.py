@@ -217,6 +217,34 @@ def test_reader_crash_respawns_with_unchanged_stream(shards_dir):
     assert reg.get("data_reader_respawns").value >= 1
 
 
+def test_reader_crash_writes_supervisor_event_with_positions(
+        shards_dir, tmp_path, monkeypatch):
+    """Under the launcher (DTF_HEARTBEAT_DIR exported), a reader
+    respawn appends a `reader_crash` record to supervisor_events.jsonl
+    carrying the recorded per-shard positions — post-mortems see the
+    data position next to the restart decision."""
+    import json
+
+    monkeypatch.setenv("DTF_HEARTBEAT_DIR", str(tmp_path))
+    monkeypatch.setenv("DTF_PROCESS_ID", "3")
+    chaos.configure("reader_crash@batch:3")
+    s = ServiceStream(shards_dir, 4, seed=7, num_shards=2, num_workers=1)
+    _collect(s, 8)
+    assert s.respawns >= 1
+    path = tmp_path / "supervisor_events.jsonl"
+    recs = [json.loads(ln) for ln in open(path)]
+    crash = [r for r in recs if r["event"] == "reader_crash"]
+    assert len(crash) == s.respawns
+    r = crash[0]
+    assert r["rank"] == 3 and r["worker"] == 0
+    # positions recorded per shard, at/after the crash batch — the
+    # respawned worker resumes exactly there
+    assert set(r["shard_positions"]) == {"0", "1"}
+    assert all(isinstance(v, int) and v >= 1
+               for v in r["shard_positions"].values())
+    assert "ts" in r and r["respawns"] >= 1
+
+
 def test_reader_crash_inline_is_harmless(shards_dir):
     chaos.configure("reader_crash@batch:2")
     want = _collect(ServiceStream(shards_dir, 4, seed=7, num_shards=2), 4)

@@ -310,6 +310,43 @@ def test_trace_main_missing_dir(tmp_path):
         trace_main([str(tmp_path / "empty")])
 
 
+def test_trace_main_merge_time_ordered_cross_rank(tmp_path, capsys):
+    """--merge interleaves every rank's records into ONE stream sorted
+    by timestamp, each record rank-tagged — the cross-rank post-mortem
+    view."""
+    for rank in (0, 1):
+        t = trace.configure(str(tmp_path), rank=rank)
+        for step in range(3):
+            with trace.span("step", step=step):
+                time.sleep(0.002)
+        trace.event("heartbeat", step=2)
+        t.flush()
+        trace.disable()
+    assert trace_main([str(tmp_path), "--merge"]) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    # every record from both ranks, rank-tagged
+    assert {r["rank"] for r in lines} == {0, 1}
+    assert sum(r.get("kind") == "span" and r.get("name") == "step"
+               for r in lines) == 6
+    # the stream is time-ordered
+    ts = [float(r["ts"]) for r in lines]
+    assert ts == sorted(ts)
+    # rank 0's steps finished before rank 1 started writing here, so a
+    # correct merge cannot simply concatenate files — order mixes the
+    # trace_start/step records by wall clock
+    assert all("ts" in r for r in lines)
+
+
+def test_trace_main_merge_composes_with_check(tmp_path, capsys):
+    _write_trace(tmp_path, with_anomaly=True)
+    assert trace_main([str(tmp_path), "--merge", "--check"]) == 1
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()
+             if ln.startswith("{")]
+    assert any(r.get("kind") == "anomaly" for r in lines)
+
+
 # --- end-to-end: traced smoke train ---------------------------------------
 
 def test_traced_smoke_train_reconciles_step_spans(tmp_path):

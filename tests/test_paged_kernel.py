@@ -1,0 +1,173 @@
+"""Pallas paged flash-decode kernel: interpret-mode validation on CPU.
+
+The kernel reads KV pages THROUGH the block table in-kernel (scalar-
+prefetched index maps), so no gathered window ever materializes and the
+window trim is a fused dynamic predicate.  Tier-1 pins, per the same
+contract the flash kernels use (ops/flash_attention.py):
+
+  - kernel ≡ blockwise reference BIT-exact (identical accumulation
+    order, identical math — any drift is a kernel bug);
+  - kernel ≡ the `paged_attention` gather oracle to float ulps
+    (batched-vs-per-program einsum reduction order differs) with
+    argmax equality — the sampling-visible quantity;
+  - the dispatch (`paged_attention_auto`) routes kernel-on-TPU /
+    gather-elsewhere, with "interpret" forcing the kernel through the
+    Pallas interpreter (this file's mode);
+  - end-to-end: an engine generation with use_pallas="interpret"
+    reproduces the gather path's exact greedy tokens.
+
+Geometry matrix: index values 1 / page−1 / page / 3·page+7 — the same
+page-boundary edges the paged gather tests pin — at decode (S=1) and
+chunk (S=page-multiple) query shapes.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import importlib
+
+from dtf_tpu.models.transformer import TransformerLM
+from dtf_tpu.serve import ServeEngine
+
+# the ops package re-exports the `paged_attention` FUNCTION under the
+# module's name — import the module itself for the kernel symbols
+pa = importlib.import_module("dtf_tpu.ops.paged_attention")
+
+PAGE = 8
+LENS = (1, PAGE - 1, PAGE, 3 * PAGE + 7)        # 1, 7, 8, 31
+POOL, M, H, D = 24, 6, 4, 16                     # M pages cover 48 tokens
+
+
+def _case(seed, b, s):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, s, H, D)), jnp.float32)
+    pk = jnp.asarray(rng.standard_normal((POOL, PAGE, H, D)), jnp.float32)
+    pv = jnp.asarray(rng.standard_normal((POOL, PAGE, H, D)), jnp.float32)
+    # distinct non-scratch pages WITHIN each row (an engine block row
+    # never repeats a page); rows may overlap — that's prefix sharing
+    tbl = np.stack([rng.choice(np.arange(1, POOL), M, replace=False)
+                    for _ in range(b)])
+    return q, pk, pv, jnp.asarray(tbl, jnp.int32)
+
+
+@pytest.mark.parametrize("index", LENS)
+def test_kernel_matches_reference_decode(index):
+    """S=1 (decode step) at every page-geometry edge vs the blockwise
+    reference: same per-page online-softmax math, so agreement is at
+    XLA's batched-vs-per-program einsum reassociation level (float
+    ulps — the reference docstring's documented-only divergence), with
+    identical argmax."""
+    q, pk, pv, tbl = _case(index, 3, 1)
+    idx = jnp.full((3,), index, jnp.int32)
+    kern = np.asarray(
+        pa.paged_flash_decode(q, pk, pv, tbl, idx, interpret=True))
+    ref = np.asarray(pa.paged_flash_decode_reference(q, pk, pv, tbl, idx))
+    np.testing.assert_allclose(kern, ref, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(kern.argmax(-1), ref.argmax(-1))
+
+
+@pytest.mark.parametrize("index", LENS)
+def test_kernel_matches_gather_oracle_decode(index):
+    """Kernel vs the materialized-gather oracle: float-ulp close, and
+    the argmax over the head-output features — the quantity greedy
+    sampling consumes downstream — identical."""
+    q, pk, pv, tbl = _case(100 + index, 3, 1)
+    idx = jnp.full((3,), index, jnp.int32)
+    kern = np.asarray(
+        pa.paged_flash_decode(q, pk, pv, tbl, idx, interpret=True))
+    oracle = np.asarray(pa.paged_attention(q, pk, pv, tbl, idx))
+    np.testing.assert_allclose(kern, oracle, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(kern.argmax(-1), oracle.argmax(-1))
+
+
+@pytest.mark.parametrize("start", [0, PAGE, 3 * PAGE])
+def test_kernel_matches_gather_oracle_chunk(start):
+    """S=page-multiple (continuation prefill chunk) at several chunk
+    starts; the gather arm gets the STATIC window trim the engine
+    would pass, the kernel's fused dynamic skip must agree."""
+    s = 2 * PAGE
+    q, pk, pv, tbl = _case(start + 7, 2, s)
+    idx = jnp.full((2,), start, jnp.int32)
+    window = (start + s) // PAGE
+    kern = np.asarray(
+        pa.paged_flash_decode(q, pk, pv, tbl, idx, interpret=True))
+    oracle = np.asarray(pa.paged_attention(
+        q, pk, pv, tbl[:, :window], idx))
+    np.testing.assert_allclose(kern, oracle, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(kern.argmax(-1), oracle.argmax(-1))
+
+
+def test_kernel_mixed_row_lengths_and_idle_rows():
+    """One batch mixing all geometry edges plus an idle row (all-zeros
+    block table, index 0 — the engine's inactive-slot shape): each
+    row's output matches the oracle's."""
+    b = len(LENS) + 1
+    q, pk, pv, tbl = _case(42, b, 1)
+    tbl = tbl.at[-1].set(0)                      # idle row → scratch page
+    idx = jnp.asarray(list(LENS) + [0], jnp.int32)
+    kern = np.asarray(
+        pa.paged_flash_decode(q, pk, pv, tbl, idx, interpret=True))
+    oracle = np.asarray(pa.paged_attention(q, pk, pv, tbl, idx))
+    np.testing.assert_allclose(kern, oracle, rtol=1e-6, atol=1e-6)
+
+
+def test_auto_dispatch_routes_by_flag(monkeypatch):
+    """use_pallas=False → gather; "interpret"/True → kernel; None on a
+    CPU backend → gather (the TPU default-on is the same branch,
+    keyed off jax.default_backend())."""
+    calls = []
+    monkeypatch.setattr(pa, "paged_flash_decode",
+                        lambda *a, **k: calls.append(
+                            ("kernel", k.get("interpret"))))
+    monkeypatch.setattr(pa, "paged_attention",
+                        lambda *a, **k: calls.append(("gather", None)))
+    args = (None, None, None, None, None)
+    pa.paged_attention_auto(*args, use_pallas=False)
+    pa.paged_attention_auto(*args, use_pallas="interpret")
+    pa.paged_attention_auto(*args, use_pallas=True)
+    pa.paged_attention_auto(*args, use_pallas=None)   # CPU here
+    assert calls == [("gather", None), ("kernel", True),
+                     ("kernel", False), ("gather", None)]
+
+
+def test_auto_gather_applies_window_trim(monkeypatch):
+    """The gather arm still gets the static window trim (the kernel
+    ignores it — its dynamic predicate skips the same pages)."""
+    seen = {}
+
+    def fake_gather(q, pk, pv, table, index):
+        seen["cols"] = table.shape[1]
+        return None
+
+    monkeypatch.setattr(pa, "paged_attention", fake_gather)
+    tbl = jnp.zeros((2, 6), jnp.int32)
+    pa.paged_attention_auto(None, None, None, tbl, None,
+                            window_pages=3, use_pallas=False)
+    assert seen["cols"] == 3
+
+
+def test_engine_generation_interpret_kernel_token_exact():
+    """End-to-end: the full engine pipeline with the model's attention
+    routed through the interpret-mode kernel reproduces the gather
+    path's exact greedy tokens — the kernel slots into write-then-
+    attend, chunked prefill, and continuous batching unchanged."""
+    model = TransformerLM(vocab_size=64, num_layers=2, d_model=32,
+                          num_heads=2, d_ff=64, max_seq_len=32)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 32), jnp.int32))["params"]
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, 64, (n,)).astype(np.int32)
+               for n in (1, PAGE - 1, PAGE, 3 * PAGE + 1)]
+    results = {}
+    for mode, m in [("gather", model),
+                    ("kernel", model.clone(use_pallas="interpret"))]:
+        eng = ServeEngine(m, params, max_batch=4, max_seq_len=32,
+                          kv_page_size=PAGE, max_delay_s=0.0)
+        try:
+            hs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+            results[mode] = [h.result(timeout=300).tokens for h in hs]
+        finally:
+            eng.stop(drain=False)
+    assert results["kernel"] == results["gather"]

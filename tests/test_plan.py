@@ -572,6 +572,78 @@ def test_plan_main_ranks_and_writes_artifact(tmp_path):
     assert art["plans"][0]["feasible"] is True
 
 
+def test_plan_cache_hit_reproduces_search_and_keys_strictly(tmp_path):
+    """The sidecar memoizes the EXACT ranking (hit ≡ fresh search,
+    object for object), keys on (workload, mesh, batch) strictly
+    (different batch = miss), and degrades a corrupt file to a
+    recompute instead of failing the resolve."""
+    from dtf_tpu.plan.cache import cached_search
+    from dtf_tpu.plan.compile import stats_for_config
+    from dtf_tpu.plan.mesh_spec import mesh_spec
+
+    cfg = Config(model="transformer_small", dataset="lm", batch_size=8,
+                 seq_len=64)
+    stats = stats_for_config(cfg)
+    mesh = mesh_spec("cpu")
+    path = str(tmp_path / "plan_cache.json")
+    fresh, hit1 = cached_search(path, stats, mesh, 8)
+    again, hit2 = cached_search(path, stats, mesh, 8)
+    assert not hit1 and hit2
+    assert ([r.to_dict() for r in again] == [r.to_dict() for r in fresh])
+    _, hit3 = cached_search(path, stats, mesh, 16)
+    assert not hit3                        # batch is part of the key
+    _, hit4 = cached_search(path, stats, mesh_spec("4x4"), 8)
+    assert not hit4                        # mesh descriptor too
+    with open(path, "w") as f:
+        f.write("{not json")
+    recomputed, hit5 = cached_search(path, stats, mesh, 8)
+    assert not hit5
+    assert ([r.to_dict() for r in recomputed]
+            == [r.to_dict() for r in fresh])
+    _, hit6 = cached_search(path, stats, mesh, 8)   # rewritten after
+    assert hit6
+
+
+def test_plan_main_uses_cache_on_repeat(tmp_path):
+    """Repeated --plan_cache rankings: first run misses and writes the
+    sidecar, second hits and skips the search."""
+    cache = str(tmp_path / "cache.json")
+    args = ("--model", "transformer_tpu", "--dataset", "lm",
+            "--seq_len", "2048", "--batch_size", "256",
+            "--dtype", "bf16", "--optimizer", "adamw",
+            "--plan_mesh", "4x4", "--top", "3", "--plan_cache", cache)
+    r1 = _plan_main(*args)
+    assert r1.returncode == 0, r1.stderr
+    assert "plan cache: miss" in r1.stdout
+    assert os.path.exists(cache)
+    r2 = _plan_main(*args)
+    assert r2.returncode == 0, r2.stderr
+    assert "plan cache: HIT — search skipped" in r2.stdout
+    # the ranking table is unchanged by the cache
+    tbl = lambda s: [ln for ln in s.splitlines()
+                     if ln.strip().startswith(("1 ", "2 ", "3 "))]
+    assert tbl(r1.stdout) == tbl(r2.stdout)
+
+
+def test_resolve_plan_auto_through_cache(tmp_path):
+    """--plan auto resolution (the runner path) through the sidecar
+    compiles the same flags as the uncached resolve."""
+    from dtf_tpu.plan.compile import resolve_plan
+
+    base = Config(model="transformer_small", dataset="lm", batch_size=8,
+                  seq_len=64, plan="auto", plan_mesh="cpu")
+    want = resolve_plan(base)
+    cache = str(tmp_path / "cache.json")
+    got1 = resolve_plan(base.replace(plan_cache=cache))
+    got2 = resolve_plan(base.replace(plan_cache=cache))   # the hit
+    for got in (got1, got2):
+        assert (got.model_parallelism, got.seq_parallelism,
+                got.optimizer_sharding, got.grad_accum_steps,
+                got.remat) == (
+            want.model_parallelism, want.seq_parallelism,
+            want.optimizer_sharding, want.grad_accum_steps, want.remat)
+
+
 def test_plan_main_auto_rejects_all_infeasible():
     """`--plan auto` on an all-infeasible lattice must exit 2, not
     rank-and-exit-0 (and --calibrate must never get the chance to run

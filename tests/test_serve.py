@@ -99,9 +99,11 @@ def test_decode_prefill_chunk_matches_stepwise(model_and_params):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_decode_rejects_sharded_config():
-    model = tiny_model(model_axis="model", decode=True)
-    with pytest.raises(ValueError, match="single-device"):
+def test_decode_rejects_seq_sharded_config():
+    """seq_axis (ring attention) still refuses decode; model_axis now
+    composes — that path is tests/test_serve_tp.py's subject."""
+    model = tiny_model(seq_axis="seq", decode=True)
+    with pytest.raises(ValueError, match="seq_axis"):
         model.init(jax.random.key(0), jnp.zeros((1, SEQ), jnp.int32),
                    cache_index=jnp.zeros((1,), jnp.int32))
 

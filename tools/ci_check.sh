@@ -46,9 +46,16 @@
 #      with a DIFFERENT worker count — to a bit-identical per-step
 #      loss trajectory (the PR-4 guarantee, extended to the flagship
 #      workload).
+#   8. tools/serve_smoke.py — the distributed-serving contract
+#      (dtf_tpu/serve) on a 4-virtual-device CPU mesh: TP=2 decode
+#      (Megatron params + head-sharded KV page pool under shard_map)
+#      is token-exact vs TP=1, and the shared-prefix bench scenario's
+#      bars hold — prefix sharing fits >= 2x the concurrent sequences
+#      of the no-sharing pool at equal page budget, and the first
+#      STREAMED token lands before full retire.
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-7 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-8 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -56,18 +63,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/7]: tier-1 test suite =="
+    echo "== ci_check [1/8]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/7]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/8]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/7]: marker audit (test-budget contract) =="
+echo "== ci_check [2/8]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/7]: traced smoke run =="
+echo "== ci_check [3/8]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -75,13 +82,13 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/7]: anomaly cleanliness =="
+echo "== ci_check [4/8]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
 
-echo "== ci_check [5/7]: chaos smoke (kill -> resume -> exactness) =="
+echo "== ci_check [5/8]: chaos smoke (kill -> resume -> exactness) =="
 python tools/chaos_smoke.py
 
-echo "== ci_check [6/7]: parallelism planner (check + calibration) =="
+echo "== ci_check [6/8]: parallelism planner (check + calibration) =="
 python bench_plan.py --out "$TRACE_DIR/PLAN_4x4.json" >/dev/null
 python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
     --dataset lm --use_synthetic_data --seq_len 64 --batch_size 8 \
@@ -95,7 +102,10 @@ python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
     --benchmark_log_dir "$TRACE_DIR/plan_bench"
 grep -q plan_step_time_ratio "$TRACE_DIR/plan_bench/metric.log"
 
-echo "== ci_check [7/7]: data-service smoke (sharded determinism + imagenet resume exactness) =="
+echo "== ci_check [7/8]: data-service smoke (sharded determinism + imagenet resume exactness) =="
 python tools/data_service_smoke.py
+
+echo "== ci_check [8/8]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
+python tools/serve_smoke.py
 
 echo "ci_check: OK"

@@ -1,0 +1,102 @@
+"""Multi-device CPU serve smoke — the distributed-serving CI contract.
+
+Two assertions, both fatal (nonzero exit), on a 4-virtual-device CPU
+mesh (`--xla_force_host_platform_device_count=4`, the same stand-in
+the tier-1 suite uses for a TPU pod slice):
+
+  1. TP EXACTNESS — a TP=2 engine (params in the Megatron layout, KV
+     page pool sharded on its head dim, every step under shard_map)
+     produces token streams IDENTICAL to the TP=1 engine for a burst
+     of varied-length prompts spanning the page-geometry edges.
+  2. SHARED-PREFIX + STREAMING BARS — bench_serve.py's shared-prefix
+     scenario at smoke scale: N concurrent requests over one system
+     prompt against a pool too small for N unshared copies must fit
+     ≥ 2× the concurrent sequences of the sharing-off pool at equal
+     page budget, and the first STREAMED token must land before full
+     retire (p50).
+
+Usage: python tools/serve_smoke.py          (ci_check.sh stage 8)
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax                    # noqa: E402
+import jax.numpy as jnp       # noqa: E402
+import numpy as np            # noqa: E402
+
+PS = 16
+
+
+def main() -> int:
+    from dtf_tpu.models.transformer import TransformerLM
+    from dtf_tpu.serve import ServeEngine, place_for_serving, serving_mesh
+    import bench_serve
+
+    assert jax.device_count() >= 4, (
+        f"expected 4 virtual CPU devices, got {jax.device_count()}")
+    model = TransformerLM(vocab_size=256, num_layers=2, d_model=64,
+                          num_heads=4, d_ff=128, max_seq_len=256)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 256), jnp.int32))["params"]
+
+    # -- 1. TP=2 token-exact vs TP=1 ------------------------------------
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 256, (n,)).astype(np.int32)
+               for n in (1, PS - 1, PS, 3 * PS + 7, 40, 9)]
+    mesh = serving_mesh(2)
+    tp_params = place_for_serving({"params": params}, mesh=mesh,
+                                  model_parallelism=2)["params"]
+    streams = {}
+    for name, p, m in [("tp1", params, None), ("tp2", tp_params, mesh)]:
+        eng = ServeEngine(model, p, max_batch=4, max_seq_len=256,
+                          kv_page_size=PS, max_delay_s=0.0, mesh=m)
+        try:
+            hs = [eng.submit(pr, max_new_tokens=8) for pr in prompts]
+            streams[name] = [h.result(timeout=600).tokens for h in hs]
+        finally:
+            eng.stop(drain=False)
+    if streams["tp1"] != streams["tp2"]:
+        print("serve smoke FAILED: TP=2 decode diverged from TP=1:\n"
+              f"  tp1: {streams['tp1']}\n  tp2: {streams['tp2']}",
+              file=sys.stderr)
+        return 1
+    print(f"serve smoke: TP=2 token-exact vs TP=1 over {len(prompts)} "
+          f"prompts ({sum(len(t) for t in streams['tp1'])} tokens)")
+
+    # -- 2. shared-prefix + streaming bars ------------------------------
+    sys_pages = 8
+    pool = bench_serve.prefix_pool_pages(8, sys_pages, PS)
+    _, c_share, _, ttft, full = bench_serve.shared_prefix_scenario(
+        model, params, batch=8, seq=256, requests=8, kv_page_size=PS,
+        kv_pool_pages=pool, sys_pages=sys_pages, prefix_sharing=True,
+        label="smoke_sharing")
+    _, c_noshare, _, _, _ = bench_serve.shared_prefix_scenario(
+        model, params, batch=8, seq=256, requests=8, kv_page_size=PS,
+        kv_pool_pages=pool, sys_pages=sys_pages, prefix_sharing=False,
+        label="smoke_nosharing")
+    if c_share < 2 * c_noshare:
+        print(f"serve smoke FAILED: prefix sharing fits {c_share} "
+              f"concurrent sequences vs {c_noshare} without — below the "
+              f"2x bar at {pool - 1} usable pages", file=sys.stderr)
+        return 1
+    if ttft >= full:
+        print(f"serve smoke FAILED: first streamed token p50 {ttft:.3f}s "
+              f"not below full-retire p50 {full:.3f}s", file=sys.stderr)
+        return 1
+    print(f"serve smoke: prefix sharing {c_share} vs {c_noshare} "
+          f"concurrent (>=2x bar), stream ttft p50 {ttft:.3f}s < "
+          f"full-retire p50 {full:.3f}s")
+    print("serve smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
