@@ -27,14 +27,34 @@ Prints ONE JSON line per metric, bench.py contract ({"metric", "value",
      Bars: sharing fits ≥ 2× the concurrent sequences of no-sharing
      at equal page budget; first-streamed-token p50 < full-retire
      p50.
+  5. REPLICA TIER (--router_replicas N; 0 skips): real replica
+     subprocesses behind the serve/router.py front-end —
+       · replica scaling: 1-replica vs N-replica tokens/s under the
+         same burst (report-only: this container is core-bound);
+       · OVERLOAD DEGRADES, NEVER HANGS: with every replica saturated,
+         new submits resolve with Backpressure(retry_after) within a
+         bounded time (bar: max time-to-Backpressure < 5 s, zero
+         unresolved handles);
+       · PREFIX-AFFINE vs RANDOM placement: the same shared-prompt
+         traffic, measured by the replicas' own PrefixRegistry hit
+         counters (bar: affinity hits > random hits);
+       · KILL UNDER LOAD: SIGKILL a replica mid-burst (bar: zero lost
+         requests, ≥ 1 failover, every request completes).
+
+--out writes every metric line into ONE BenchmarkMetric JSON artifact
+(BENCH_serve_rNN.json shape) so the serving perf trajectory is tracked
+across PRs like training's BENCH_r0N.json files.
 
 Run: python bench_serve.py [--model transformer_small] [--batch 8]
-     [--steps 64] [--seq 256]
+     [--steps 64] [--seq 256] [--router_replicas 2] [--out FILE]
 """
 
 import argparse
+import datetime
 import json
 import os
+import sys
+import tempfile
 import time
 
 import jax
@@ -51,9 +71,36 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_RECORDS = []      # every metric line, for the --out artifact
+
+
 def _jline(metric, value, unit, **extra):
-    print(json.dumps({"metric": metric, "value": round(float(value), 4),
-                      "unit": unit, "vs_baseline": None, **extra}))
+    rec = {"metric": metric, "value": round(float(value), 4),
+           "unit": unit, "vs_baseline": None, **extra}
+    _RECORDS.append(rec)
+    print(json.dumps(rec))
+
+
+def write_artifact(path, model, bars):
+    """The BENCH_serve artifact: every metric line of this run plus the
+    bar verdicts, one JSON file — the serving perf trajectory's unit
+    of comparison across PRs (BENCH_r0N.json's serving sibling)."""
+    devices = jax.devices()
+    payload = {
+        "bench": "bench_serve",
+        "run_date": datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+        "model": model,
+        "device_kind": devices[0].device_kind if devices else "unknown",
+        "platform": devices[0].platform if devices else "unknown",
+        "bars_failed": bars,
+        "metrics": _RECORDS,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    print(f"# wrote {path} ({len(_RECORDS)} metrics, "
+          f"{len(bars)} failed bars)")
 
 
 # shared-prefix scenario shape, single-sourced: the pool sizing in
@@ -255,6 +302,218 @@ def shared_prefix_scenario(model, params, *, batch: int, seq: int,
     return stats, maxc, high, ttft_p50, full_p50
 
 
+# ---------------------------------------------------------------------------
+# replica tier (serve/router.py over real replica subprocesses)
+# ---------------------------------------------------------------------------
+
+ROUTER_SEED = 11
+# the replica-tier scenarios pin their OWN model (replicas need seeded
+# identical params; the in-process --model arg never reaches them) —
+# every router_* metric line carries this so the --out artifact cannot
+# mislabel them with args.model
+ROUTER_MODEL = "transformer_small"
+ROUTER_REPLICA_FLAGS = [
+    "--serve_random_init", "--model", ROUTER_MODEL,
+    "--num_classes", "256", "--serve_max_seq_len", "128",
+    "--serve_max_batch", "4", "--serve_queue_size", "16",
+    "--heartbeat_secs", "0.2", "--seed", str(ROUTER_SEED),
+]
+
+
+def router_tier(workdir, n, *, placement="affinity", admission=128,
+                deadline_s=120.0, inflight=4, replica_flags=()):
+    # inflight defaults to the replica SLOT count: bursts queue at the
+    # ROUTER and trickle into replicas at their concurrency, so a
+    # healthy-tier scenario never trips replica-level sheds.  The
+    # overload scenario overrides it UP — and shrinks the replica
+    # queue — precisely to trip them.
+    from dtf_tpu.serve.router import Router, replica_spawner
+    rdv = os.path.join(workdir, "rdv")
+    cmd = [sys.executable, "-m", "dtf_tpu.cli.replica_main",
+           "--rendezvous_dir", rdv, *ROUTER_REPLICA_FLAGS,
+           *replica_flags]
+    router = Router(n, rdv, spawn=replica_spawner(cmd, rdv),
+                    page_size=16, probe_interval_s=0.25,
+                    health_timeout_s=5.0, deadline_s=deadline_s,
+                    admission_limit=admission, replica_inflight=inflight,
+                    placement=placement, seed=3)
+    router.start(wait_s=600)
+    return router
+
+
+def router_burst(router, requests, budget=24, seed=0, plen=(8, 33)):
+    """Submit a burst, resolve everything.  Returns (tokens/s, lost,
+    results)."""
+    from dtf_tpu.serve import Backpressure, DeadlineExceeded
+    rng = np.random.default_rng(seed)
+    t0 = time.time()
+    handles = [router.submit(
+        rng.integers(0, 256, (int(rng.integers(*plen)),)).astype(np.int32),
+        max_new_tokens=budget) for _ in range(requests)]
+    tokens, lost = 0, 0
+    for h in handles:
+        try:
+            tokens += len(h.result(timeout=router.deadline_s + 30).tokens)
+        except (Backpressure, DeadlineExceeded):
+            lost += 1
+    wall = time.time() - t0
+    return tokens / wall if wall > 0 else 0.0, lost, len(handles)
+
+
+def router_scaling_and_kill(tmpdir, replicas, requests):
+    """Replica scaling (1 vs N, report-only on a core-bound container)
+    then kill-under-load on the N-replica tier.  Returns the list of
+    failed bars."""
+    bars = []
+    tps1, lost1, _ = None, 0, 0
+    r1 = router_tier(os.path.join(tmpdir, "tier1"), 1)
+    try:
+        router_burst(r1, 4, seed=9)    # warm the tier's steady state
+        tps1, lost1, _ = router_burst(r1, requests, seed=10)
+    finally:
+        r1.stop(drain=True)
+    rN = router_tier(os.path.join(tmpdir, "tierN"), replicas)
+    try:
+        router_burst(rN, 4, seed=9)
+        tpsN, lostN, _ = router_burst(rN, requests, seed=10)
+        scale = tpsN / tps1 if tps1 else 0.0
+        _jline("router_replica_scaling", scale, "x", model=ROUTER_MODEL,
+               replicas=replicas,
+               tokens_per_s_1=round(tps1, 2),
+               tokens_per_s_n=round(tpsN, 2),
+               note="report-only: container is core-bound")
+        if lost1 or lostN:
+            bars.append(f"router scaling lost requests "
+                        f"({lost1}+{lostN}) on a healthy tier")
+
+        # kill under load: SIGKILL a replica mid-burst — zero lost,
+        # >= 1 failover, every request completes
+        from dtf_tpu.serve import Backpressure, DeadlineExceeded
+        rng = np.random.default_rng(21)
+        handles = [rN.submit(
+            rng.integers(0, 256, (12,)).astype(np.int32),
+            max_new_tokens=32) for _ in range(requests)]
+        time.sleep(0.4)                 # burst in flight on both
+        rN.kill_replica(0)
+        lost = 0
+        for h in handles:
+            try:
+                h.result(timeout=rN.deadline_s + 30)
+            except (Backpressure, DeadlineExceeded):
+                lost += 1
+        failovers = rN.metrics.get("router_failover_total").value
+        _jline("router_kill_under_load_lost", lost, "requests",
+               model=ROUTER_MODEL, requests=requests, failovers=failovers,
+               respawns=rN.metrics.get(
+                   "router_replica_respawns_total").value)
+        if lost:
+            bars.append(f"kill-under-load lost {lost}/{requests} "
+                        f"requests (bar: zero)")
+        if failovers < 1:
+            bars.append("kill-under-load saw no failover — the kill "
+                        "missed all in-flight work")
+    finally:
+        rN.stop(drain=True)
+    return bars
+
+
+def router_overload_bar(tmpdir, replicas):
+    """All replicas saturated: new submits must resolve with
+    Backpressure within a BOUNDED time (degrade, never hang)."""
+    from dtf_tpu.serve import Backpressure, DeadlineExceeded
+    bars = []
+    router = router_tier(os.path.join(tmpdir, "overload"), replicas,
+                         admission=10, inflight=32,
+                         replica_flags=("--serve_queue_size", "2"))
+    try:
+        router_burst(router, 2, seed=1)   # warm
+        rng = np.random.default_rng(13)
+        outcomes = {"ok": 0, "bp_immediate": 0, "bp_async": 0,
+                    "deadline": 0}
+        bp_latency_max = 0.0
+        pending = []
+        # replicas hold 4 slots + 2 queued each; admission 10; 30
+        # submits guarantee saturation at both levels
+        for _ in range(30):
+            t0 = time.monotonic()
+            try:
+                pending.append((t0, router.submit(
+                    rng.integers(0, 256, (12,)).astype(np.int32),
+                    max_new_tokens=48)))
+            except Backpressure:
+                outcomes["bp_immediate"] += 1
+        for t0, h in pending:
+            try:
+                h.result(timeout=router.deadline_s + 30)
+                outcomes["ok"] += 1
+            except Backpressure:
+                outcomes["bp_async"] += 1
+                bp_latency_max = max(bp_latency_max,
+                                     time.monotonic() - t0)
+            except DeadlineExceeded:
+                outcomes["deadline"] += 1
+        shed = outcomes["bp_immediate"] + outcomes["bp_async"]
+        _jline("router_overload_shed", shed, "requests",
+               model=ROUTER_MODEL, **outcomes,
+               bp_latency_max_s=round(bp_latency_max, 3))
+        if shed == 0:
+            bars.append("overload scenario never shed — it did not "
+                        "saturate the tier (bench bug)")
+        if bp_latency_max >= 5.0:
+            bars.append(f"async Backpressure took {bp_latency_max:.1f}s "
+                        f"(bar: < 5s) — overload must degrade FAST")
+        if outcomes["deadline"]:
+            bars.append(f"{outcomes['deadline']} requests hit their "
+                        f"deadline under overload — sheds must happen "
+                        f"at the door, not at the deadline")
+    finally:
+        router.stop(drain=True)
+    return bars
+
+
+def router_affinity_bar(tmpdir, replicas, requests_per_group=8):
+    """Prefix-affine vs random placement over identical shared-prompt
+    traffic, scored by the REPLICAS' own PrefixRegistry hit counters —
+    the measured registry hit-rate win affinity exists for."""
+    bars = []
+    hits = {}
+    for arm in ("affinity", "random"):
+        router = router_tier(os.path.join(tmpdir, f"aff_{arm}"),
+                             replicas, placement=arm)
+        try:
+            rng = np.random.default_rng(31)
+            groups = [rng.integers(0, 256, (4 * 16,)).astype(np.int32)
+                      for _ in range(2)]
+            # one warmer per group (registers the prefix somewhere),
+            # then the measured burst
+            for g in groups:
+                router.generate(g, max_new_tokens=2)
+            handles = []
+            for i in range(requests_per_group * len(groups)):
+                tail = rng.integers(0, 256, (5,)).astype(np.int32)
+                handles.append(router.submit(
+                    np.concatenate([groups[i % len(groups)], tail]),
+                    max_new_tokens=8))
+            for h in handles:
+                h.result(timeout=router.deadline_s + 30)
+            total = 0
+            for rid in range(replicas):
+                stats = router.replica_stats(rid, timeout=10)
+                total += int((stats or {}).get(
+                    "serve_prefix_hit_pages_total", 0))
+            hits[arm] = total
+        finally:
+            router.stop(drain=True)
+    _jline("router_affinity_registry_hits", hits["affinity"], "pages",
+           model=ROUTER_MODEL, random_placement=hits["random"],
+           win=bool(hits["affinity"] > hits["random"]))
+    if hits["affinity"] <= hits["random"]:
+        bars.append(
+            f"prefix-affine routing hit {hits['affinity']} registry "
+            f"pages vs random's {hits['random']} — no measured win")
+    return bars
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="transformer_small")
@@ -276,6 +535,11 @@ def main():
     # (at 512 the whole-prompt flash pass is already cheaper than one
     # chunk's gather-attend, and chunking can only add overhead)
     ap.add_argument("--mixed_seq", type=int, default=1024)
+    # replica-tier scenarios (real replica subprocesses); 0 skips them
+    ap.add_argument("--router_replicas", type=int, default=2)
+    # BENCH_serve artifact: one JSON file holding every metric line of
+    # this run (the serving trajectory's cross-PR unit)
+    ap.add_argument("--out", default="")
     args = ap.parse_args()
 
     from dtf_tpu.models import build_model
@@ -390,30 +654,61 @@ def main():
            streaming_earlier=bool(ttft_stream < full_p50))
 
     # acceptance bars, enforced the same way as the 2x decode bar — a
-    # printed false boolean that exits 0 is not a contract
+    # printed false boolean that exits 0 is not a contract.  Collected,
+    # not raised one-by-one: the --out artifact records every verdict
+    # even when an early bar fails
+    failed = []
     if ratio < 2.0:
-        raise SystemExit(
+        failed.append(
             f"batched decode speedup {ratio:.2f}x is below the 2x bar")
     if paged_speedup < 1.2 or c_chunk < c_contig:
-        raise SystemExit(
+        failed.append(
             f"paged@50% mixed-length bar failed: {paged_speedup:.2f}x "
             f"tokens/s (bar 1.2x), concurrency {c_chunk} vs contiguous "
             f"{c_contig}")
     if g_chunk["p99"] >= g_plain["p99"]:
-        raise SystemExit(
+        failed.append(
             f"chunked prefill did not bound the decode gap: p99 "
             f"{g_chunk['p99']:.3f}s chunked vs {g_plain['p99']:.3f}s "
             f"un-chunked")
     if c_share < 2 * c_noshare:
-        raise SystemExit(
+        failed.append(
             f"prefix-sharing bar failed: {c_share} concurrent sequences "
             f"sharing vs {c_noshare} without (bar: >= 2x) at "
             f"{prefix_pool - 1} usable pages")
     if ttft_stream >= full_p50:
-        raise SystemExit(
+        failed.append(
             f"streaming bar failed: first streamed token p50 "
             f"{ttft_stream:.3f}s is not below full-retire p50 "
             f"{full_p50:.3f}s")
+
+    # replica-tier scenarios: scaling + kill-under-load, overload
+    # degrade bound, prefix-affine vs random placement
+    if args.router_replicas > 0:
+        import shutil
+        tier_dir = tempfile.mkdtemp(prefix="dtf_bench_router_")
+        clean = False
+        try:
+            failed += router_scaling_and_kill(
+                tier_dir, args.router_replicas, requests=12)
+            failed += router_overload_bar(tier_dir, args.router_replicas)
+            failed += router_affinity_bar(tier_dir, args.router_replicas)
+            clean = True
+        finally:
+            if clean and not failed:
+                shutil.rmtree(tier_dir, ignore_errors=True)
+            else:
+                # ANY non-clean exit keeps the rendezvous + replica
+                # logs — a tier that failed to start (exception, not a
+                # bar) is exactly when replica0.log matters
+                print(f"# replica-tier work dir kept for debugging: "
+                      f"{tier_dir}")
+
+    if args.out:
+        write_artifact(args.out, args.model, failed)
+    if failed:
+        raise SystemExit("bench_serve bars FAILED:\n  "
+                         + "\n  ".join(failed))
 
 
 if __name__ == "__main__":

@@ -15,9 +15,18 @@ when chaos is off — provably no behavior or cost on production runs
 Spec grammar (``--fault`` flag or the ``DTF_FAULT`` env var the
 launcher forwards; comma-separated specs compose)::
 
-    spec  := kind "@" [ "rank" INT ":" ] point
+    spec  := kind "@" [ selector ":" ] point
+    selector := "rank" INT | "replica" INT
     point := "step" ":" INT | "version" ":" INT | "batch" ":" INT
-             | "latest"
+             | "req" ":" INT | "latest" | INT-or-FLOAT
+
+The ``rank`` selector picks which PROCESS a fault fires in; the
+``replica`` selector names which serving replica a distributed fault
+TARGETS (the fault itself fires where the observation point lives —
+``net_partition``/``replica_kill`` fire in the router, ``slow_replica``
+in the targeted replica process, where replica id == DTF_PROCESS_ID).
+The bare numeric point form is the distributed kinds' shorthand:
+``net_partition@replica1:6`` means 6 probe ticks.
 
 Kinds and their firing semantics:
 
@@ -48,6 +57,27 @@ Kinds and their firing semantics:
                           its recorded per-shard position and the
                           merged stream must be unchanged
                           (dtf_tpu/data/service).
+  replica_kill@req:N      the serving ROUTER SIGKILLs a replica as it
+                          dispatches its Nth request (exact match,
+                          one-shot) — by default the replica that Nth
+                          request was just routed to; an explicit
+                          ``replica<K>`` selector overrides the target
+                          (``replica_kill@replica0:req:3``).  The
+                          router must re-dispatch the dead replica's
+                          in-flight requests token-exactly and respawn
+                          it under the restart budget.
+  net_partition@replicaK:D  the router's health PROBES of replica K are
+                          dropped for D consecutive probe ticks,
+                          starting at the first probe after traffic
+                          began — the router sees silence (a partition
+                          or stalled host), NOT a clean exit; the
+                          replica process itself stays healthy and must
+                          re-register when the partition heals.
+  slow_replica@replicaK:F replica K's decode steps run F× slower
+                          (latched; the engine sleeps (F−1)× each
+                          measured step) — the straggler signature the
+                          router's deadline + least-loaded placement
+                          must absorb.
 
 Every fired fault emits a structured ``injected_fault`` anomaly record
 through obs.trace (flushed before dying), so
@@ -73,7 +103,7 @@ EXIT_PREEMPTED = 75        # EX_TEMPFAIL: graceful preemption checkpoint
 EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
 
 KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
-         "reader_crash")
+         "reader_crash", "replica_kill", "net_partition", "slow_replica")
 _POINTS = {
     "crash": "step",
     "sigterm": "step",
@@ -81,7 +111,14 @@ _POINTS = {
     "ps_drop": "version",
     "ckpt_truncate": "latest",
     "reader_crash": "batch",
+    "replica_kill": "req",
+    "net_partition": "ticks",
+    "slow_replica": "factor",
 }
+# distributed kinds whose point accepts the bare-value shorthand
+# (net_partition@replica1:6) and which require/allow a replica target
+_REPLICA_REQUIRED = ("net_partition", "slow_replica")
+_BARE_POINT = ("net_partition", "slow_replica")
 
 _injector: Optional["Injector"] = None
 _lock = threading.Lock()
@@ -91,7 +128,9 @@ _lock = threading.Lock()
 class FaultSpec:
     kind: str
     rank: Optional[int]     # None = every rank
-    value: Optional[int]    # None for point "latest"
+    value: Optional[float]  # None for point "latest"; float only for
+                            # slow_replica's factor, int otherwise
+    replica: Optional[int] = None  # distributed kinds: target replica
     fired: bool = False
 
     @property
@@ -99,9 +138,18 @@ class FaultSpec:
         return _POINTS[self.kind]
 
     def __str__(self) -> str:
-        r = f"rank{self.rank}:" if self.rank is not None else ""
-        p = "latest" if self.value is None else f"{self.point}:{self.value}"
-        return f"{self.kind}@{r}{p}"
+        sel = ""
+        if self.rank is not None:
+            sel = f"rank{self.rank}:"
+        elif self.replica is not None:
+            sel = f"replica{self.replica}:"
+        if self.value is None:
+            p = "latest"
+        else:
+            v = (self.value if self.kind == "slow_replica"
+                 else int(self.value))
+            p = f"{self.point}:{v}"
+        return f"{self.kind}@{sel}{p}"
 
 
 def parse_spec(text: str) -> List[FaultSpec]:
@@ -120,6 +168,7 @@ def parse_spec(text: str) -> List[FaultSpec]:
                 f"fault spec {tok!r}: unknown kind {kind!r} "
                 f"(choose from {KINDS})")
         rank: Optional[int] = None
+        replica: Optional[int] = None
         if point.startswith("rank"):
             rtok, _, point = point.partition(":")
             try:
@@ -127,24 +176,47 @@ def parse_spec(text: str) -> List[FaultSpec]:
             except ValueError:
                 raise ValueError(
                     f"fault spec {tok!r}: bad rank selector {rtok!r}")
+        elif point.startswith("replica"):
+            rtok, _, point = point.partition(":")
+            try:
+                replica = int(rtok[7:])
+            except ValueError:
+                raise ValueError(
+                    f"fault spec {tok!r}: bad replica selector {rtok!r}")
+        if kind in _REPLICA_REQUIRED and replica is None:
+            raise ValueError(
+                f"fault spec {tok!r}: {kind} needs a replica<K> selector "
+                f"(which replica to target)")
         want = _POINTS[kind]
         if want == "latest":
             if point != "latest":
                 raise ValueError(
                     f"fault spec {tok!r}: {kind} takes the point 'latest'")
-            out.append(FaultSpec(kind, rank, None))
+            out.append(FaultSpec(kind, rank, None, replica=replica))
             continue
         sel, _, val = point.partition(":")
+        if not val and kind in _BARE_POINT:
+            # bare-value shorthand: net_partition@replica1:6
+            sel, val = want, sel
         if sel != want or not val:
-            raise ValueError(
-                f"fault spec {tok!r}: {kind} takes '{want}:<int>'")
+            hint = (f"'{want}:<value>' or a bare value"
+                    if kind in _BARE_POINT else f"'{want}:<int>'")
+            raise ValueError(f"fault spec {tok!r}: {kind} takes {hint}")
         try:
-            value = int(val)
+            value = (float(val) if kind == "slow_replica" else int(val))
         except ValueError:
-            raise ValueError(f"fault spec {tok!r}: {val!r} is not an int")
-        if value < 0:
+            raise ValueError(f"fault spec {tok!r}: {val!r} is not a number")
+        if kind == "slow_replica":
+            if value <= 1.0:
+                raise ValueError(
+                    f"fault spec {tok!r}: slow-down factor must be > 1")
+        elif kind == "net_partition":
+            if value < 1:
+                raise ValueError(
+                    f"fault spec {tok!r}: partition needs >= 1 probe tick")
+        elif value < 0:
             raise ValueError(f"fault spec {tok!r}: value must be >= 0")
-        out.append(FaultSpec(kind, rank, value))
+        out.append(FaultSpec(kind, rank, value, replica=replica))
     return out
 
 
@@ -157,6 +229,9 @@ class Injector:
         self.specs = [s for s in specs
                       if s.rank is None or s.rank == self.rank]
         self._mu = threading.Lock()
+        # net_partition bookkeeping: spec index -> remaining probe ticks
+        # (None until the partition starts)
+        self._partition_left: dict = {}
 
     def _armed(self, kind: str):
         return [s for s in self.specs if s.kind == kind and not s.fired]
@@ -241,6 +316,64 @@ class Injector:
                 return True
         return False
 
+    # -- distributed serving faults (dtf_tpu/serve/router.py) -----------
+    def replica_kill(self, req_seq: int,
+                     dispatched_to: int) -> Optional[int]:
+        """Router-side, one-shot, EXACT-match on the dispatch sequence
+        number: returns the replica id to SIGKILL when the router's
+        ``req_seq``-th dispatch should trigger the kill — the explicit
+        ``replica<K>`` target if the spec named one, else the replica
+        this request was just routed to.  None = don't fire."""
+        with self._mu:
+            for spec in self._armed("replica_kill"):
+                if int(req_seq) == spec.value:
+                    target = (spec.replica if spec.replica is not None
+                              else int(dispatched_to))
+                    self._record(spec, req=int(req_seq), replica=target)
+                    return target
+        return None
+
+    def net_partition(self, replica: int, traffic_started: bool) -> bool:
+        """Router-side, called ONCE per health-probe tick per replica:
+        True while the probe of ``replica`` should be dropped.  The
+        partition starts at the first probe tick after traffic began
+        (so it always lands mid-traffic) and lasts ``value`` ticks,
+        then heals — the replica process never died, so it must
+        re-register and take traffic again."""
+        with self._mu:
+            for i, spec in enumerate(self.specs):
+                if spec.kind != "net_partition" or spec.replica != int(
+                        replica):
+                    continue
+                left = self._partition_left.get(i)
+                if left is None:
+                    if not traffic_started:
+                        continue
+                    left = int(spec.value)
+                    self._record(spec, replica=int(replica),
+                                 ticks=left)
+                if left <= 0:
+                    continue    # healed
+                self._partition_left[i] = left - 1
+                return True
+        return False
+
+    def slow_replica(self) -> float:
+        """Replica-side, latched: the slow-down factor for THIS process
+        (replica id == rank), or 0.0 when no slow fault targets it.  A
+        straggler does not recover by itself, so the factor stays on
+        once armed."""
+        with self._mu:
+            for spec in self.specs:
+                if spec.kind != "slow_replica":
+                    continue
+                if spec.replica is not None and spec.replica != self.rank:
+                    continue
+                if not spec.fired:
+                    self._record(spec, factor=float(spec.value))
+                return float(spec.value)
+        return 0.0
+
 
 # ---------------------------------------------------------------------------
 # Module-level API (what instrumented code calls) — every probe is a
@@ -323,6 +456,27 @@ def reader_crash(batch: int) -> bool:
     if inj is None:
         return False
     return inj.reader_crash(batch)
+
+
+def replica_kill(req_seq: int, dispatched_to: int) -> Optional[int]:
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.replica_kill(req_seq, dispatched_to)
+
+
+def net_partition(replica: int, traffic_started: bool) -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.net_partition(replica, traffic_started)
+
+
+def slow_replica() -> float:
+    inj = _injector
+    if inj is None:
+        return 0.0
+    return inj.slow_replica()
 
 
 if sys.platform == "win32":  # pragma: no cover - posix repo, belt+braces

@@ -1,0 +1,214 @@
+"""Serving front-end: router + N replica serve processes.
+
+The one-command replica-tier entry: spawns ``--router_replicas``
+replica processes (cli/replica_main.py — each a full ServeEngine,
+optionally TP-sharded via --serve_tp), stands up the health-checked
+router over them (serve/router.py: prefix-affine placement, deadlines,
+retry/failover, respawn budget), drives it with synthetic shared-
+prefix traffic, and reports router + per-replica stats in the
+BenchmarkMetric format.
+
+Examples:
+  # 2 replicas on fresh params (pipeline smoke; outputs are noise):
+  python -m dtf_tpu.cli.router_main --serve_random_init \
+      --model transformer_small --router_replicas 2 --serve_requests 16
+
+  # 4 replicas over a trained checkpoint, chaos-killing replica 0 at
+  # the 6th dispatch (the failover path, live):
+  python -m dtf_tpu.cli.router_main --model_dir /tmp/lm_run \
+      --router_replicas 4 --fault replica_kill@replica0:req:6
+
+SIGTERM drains the tier: the router sheds new submits, waits out
+in-flight work, SIGTERMs the replicas (each drains + exits 0), then
+exits 0 itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+from dtf_tpu.config import parse_flags
+
+log = logging.getLogger("dtf_tpu")
+
+ROUTER_DEFAULTS = dict(
+    model="transformer_small",
+    dataset="lm",
+    skip_eval=True,
+)
+
+# flags forwarded verbatim to every replica process (the engine-shape
+# subset: every replica must build the same engine)
+_FORWARD_FLAGS = (
+    "model", "num_classes", "seed", "dtype", "model_dir", "export_dir",
+    "serve_max_batch", "serve_max_seq_len", "serve_queue_size",
+    "serve_max_delay_ms", "kv_page_size", "kv_pool_pages",
+    "serve_prefill_chunk", "serve_prefix_sharing", "serve_tp",
+    "heartbeat_secs", "rendezvous_dir",
+)
+
+
+def replica_command(cfg, random_init: bool) -> list:
+    from dtf_tpu.config.flags import Config
+    import dataclasses
+    defaults = {f.name: f.default for f in dataclasses.fields(Config)}
+    cmd = [sys.executable, "-m", "dtf_tpu.cli.replica_main"]
+    for name in _FORWARD_FLAGS:
+        val = getattr(cfg, name)
+        if val is None or val == defaults.get(name):
+            continue
+        cmd += [f"--{name}", str(val)]
+    if random_init:
+        cmd.append("--serve_random_init")
+    return cmd
+
+
+def run_router(cfg, random_init: bool = False) -> dict:
+    from dtf_tpu.serve import Backpressure, DeadlineExceeded, Router
+    from dtf_tpu.serve.router import replica_spawner
+
+    if cfg.router_health_timeout_s <= cfg.heartbeat_secs:
+        # checked HERE, not in Config: only a router run pairs the two
+        raise ValueError(
+            f"--router_health_timeout_s ({cfg.router_health_timeout_s}) "
+            f"must exceed --heartbeat_secs ({cfg.heartbeat_secs}) or "
+            f"every healthy replica reads as dead between beats")
+    rendezvous = cfg.rendezvous_dir or tempfile.mkdtemp(
+        prefix="dtf_router_")
+    cfg = cfg.replace(rendezvous_dir=rendezvous)
+    env_extra = {}
+    if cfg.trace_dir:
+        env_extra["DTF_TRACE_DIR"] = os.path.abspath(cfg.trace_dir)
+    if cfg.fault:
+        env_extra["DTF_FAULT"] = cfg.fault
+    spawn = replica_spawner(replica_command(cfg, random_init),
+                            rendezvous, env_extra=env_extra)
+    router = Router(
+        cfg.router_replicas, rendezvous, spawn=spawn,
+        page_size=cfg.kv_page_size or 16,
+        placement=cfg.router_placement,
+        deadline_s=cfg.router_deadline_s,
+        admission_limit=cfg.router_admission,
+        probe_interval_s=cfg.router_probe_s,
+        health_timeout_s=cfg.router_health_timeout_s,
+        replica_inflight=(cfg.router_replica_inflight
+                          or cfg.serve_queue_size),
+        max_respawns=cfg.router_max_respawns,
+        respawn_window_s=cfg.router_respawn_window_s,
+        respawn_backoff_s=cfg.router_respawn_backoff_s,
+        hedge_s=cfg.router_hedge_s,
+        seed=cfg.seed)
+
+    def _on_sigterm(signum, frame):
+        router.begin_drain()
+        os.write(2, b"router: SIGTERM - draining tier\n")
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass
+
+    log.info("router: spawning %d replicas (rendezvous %s)",
+             cfg.router_replicas, rendezvous)
+    # first-compile on a CPU replica can take minutes; the wait only
+    # ends early when every replica heartbeats + announces.  From here
+    # on the tier must come down with us — a traffic-loop exception
+    # must not leave N serve processes running
+    router.start(wait_s=600.0)
+    try:
+        return _drive_traffic(cfg, router)
+    except BaseException:
+        router.stop(drain=False)
+        raise
+
+
+def _drive_traffic(cfg, router) -> dict:
+    from dtf_tpu.serve import Backpressure, DeadlineExceeded
+
+    rng = np.random.default_rng(cfg.seed)
+    vocab = cfg.num_classes or 32_768
+    ps = cfg.kv_page_size or 16
+    # shared-prefix traffic: a few "system prompts" (whole pages) with
+    # per-request tails — the shape prefix-affine placement exists for
+    n_groups = max(1, min(4, cfg.router_replicas))
+    sys_prompts = [rng.integers(0, vocab, (2 * ps,)).astype(np.int32)
+                   for _ in range(n_groups)]
+    t0 = time.time()
+    handles = []
+    outcomes = {"ok": 0, "backpressure": 0, "deadline": 0}
+    for i in range(cfg.serve_requests):
+        tail = rng.integers(
+            0, vocab, (int(rng.integers(1, cfg.serve_prompt_len + 1)),)
+        ).astype(np.int32)
+        prompt = np.concatenate([sys_prompts[i % n_groups], tail])
+        try:
+            handles.append(router.submit(
+                prompt, max_new_tokens=cfg.serve_max_new_tokens,
+                temperature=cfg.serve_temperature))
+        except Backpressure:
+            outcomes["backpressure"] += 1
+    tokens = 0
+    for h in handles:
+        try:
+            r = h.result(timeout=cfg.router_deadline_s + 30)
+            tokens += len(r.tokens)
+            outcomes["ok"] += 1
+        except Backpressure:
+            outcomes["backpressure"] += 1
+        except DeadlineExceeded:
+            outcomes["deadline"] += 1
+    wall = time.time() - t0
+
+    out = {
+        "requests": cfg.serve_requests,
+        "completed": outcomes["ok"],
+        "backpressure": outcomes["backpressure"],
+        "deadline_exceeded": outcomes["deadline"],
+        "tokens_per_second": tokens / wall if wall > 0 else 0.0,
+        "replicas": cfg.router_replicas,
+        "failovers": router.metrics.get("router_failover_total").value,
+        "affinity_hits": router.metrics.get(
+            "router_affinity_hits_total").value,
+        "per_replica_completed": [
+            router.replica_completed(i)
+            for i in range(cfg.router_replicas)],
+    }
+    if cfg.benchmark_log_dir:
+        from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
+        blog = BenchmarkFileLogger(cfg.benchmark_log_dir)
+        blog.log_run_info(cfg.model, cfg.dataset, cfg.to_dict(),
+                          test_id=cfg.benchmark_test_id)
+        blog.log_registry(router.metrics)
+    router.stop(drain=True)
+    log.info("Router stats: %s", out)
+    return out
+
+
+def main(argv=None) -> dict:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    argv = list(argv if argv is not None else sys.argv[1:])
+    random_init = "--serve_random_init" in argv
+    if random_init:
+        argv.remove("--serve_random_init")
+    cfg = parse_flags(argv, defaults=ROUTER_DEFAULTS)
+    from dtf_tpu import chaos
+    from dtf_tpu.obs import trace
+    if cfg.trace_dir:
+        # the router is a NAMED stream: trace_router.jsonl next to the
+        # replicas' trace_rank{K}.jsonl — trace_main --merge interleaves
+        trace.configure(cfg.trace_dir, stream="router")
+    chaos.maybe_configure(cfg)   # replica_kill / net_partition fire here
+    return run_router(cfg, random_init=random_init)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,11 +48,19 @@ SERVE_DEFAULTS = dict(
 )
 
 
-def serve(cfg, random_init: bool = False) -> dict:
-    """Build model + params + engine from a Config; run the synthetic
-    traffic demo; return the stats dict.  Library entry for tests."""
+def build_serving_engine(cfg, random_init: bool = False,
+                         replica_rank=None):
+    """Model + params + ServeEngine from a Config — shared by this
+    main and the replica-tier entry (cli/replica_main.py).
+
+    The engine gets an obs HEARTBEAT when the launcher (or the serving
+    router) exported DTF_HEARTBEAT_DIR: the engine loop rewrites
+    ``heartbeat_rank{N}.json`` once per iteration, so launch.py's hang
+    watchdog — and the router's health probe — cover serving exactly
+    like they cover train ranks."""
     from dtf_tpu.models import build_model
-    from dtf_tpu.serve import (ServeEngine, collect_stats, load_for_serving,
+    from dtf_tpu.obs.watchdog import Heartbeat
+    from dtf_tpu.serve import (ServeEngine, load_for_serving,
                                serving_memory_plan, serving_mesh)
     from dtf_tpu.serve.bridge import place_for_serving
 
@@ -100,7 +108,18 @@ def serve(cfg, random_init: bool = False) -> dict:
         # contradiction check
         prefill_chunk=cfg.serve_prefill_chunk,
         prefix_sharing=cfg.serve_prefix_sharing and bool(cfg.kv_page_size),
-        mesh=mesh)
+        mesh=mesh,
+        heartbeat=Heartbeat.from_env(rank=replica_rank,
+                                     interval_s=cfg.heartbeat_secs))
+    return model, engine
+
+
+def serve(cfg, random_init: bool = False) -> dict:
+    """Build model + params + engine from a Config; run the synthetic
+    traffic demo; return the stats dict.  Library entry for tests."""
+    from dtf_tpu.serve import collect_stats
+
+    model, engine = build_serving_engine(cfg, random_init=random_init)
 
     # serve drain: SIGTERM (the preemption signal) stops admissions —
     # new submits shed with retry_after — finishes in-flight decodes,

@@ -11,10 +11,13 @@ Usage:
 
 ``--merge`` emits ONE time-ordered cross-rank stream (JSONL on stdout)
 instead of the aggregate table: every record from every
-``trace_rank{N}.jsonl`` sorted by timestamp, rank-tagged — the view
-that answers "what was rank 2 doing when rank 0 stalled?".  Spans sort
-by their START time (``ts``), so a long span appears where it began,
-interleaved with what ran under it.  Composes with ``--check``.
+``trace_rank{N}.jsonl`` — and every NAMED stream like the serving
+router's ``trace_router.jsonl`` — sorted by timestamp, rank-tagged
+(named streams tag their name).  The view that answers "what was rank
+2 doing when rank 0 stalled?" and "what did the router see when
+replica 1 died?".  Spans sort by their START time (``ts``), so a long
+span appears where it began, interleaved with what ran under it.
+Composes with ``--check``.
 
 ``--check`` is the CI/bench contract: exit 0 only when the trace
 contains NO anomaly records (nan_loss, step_time_regression, ...), so a
@@ -45,12 +48,30 @@ from dtf_tpu.obs.registry import Histogram
 from dtf_tpu.obs.trace import read_records
 
 
+#: anomaly kinds the subsystems emit (docs for --allow; unknown kinds
+#: only warn — forward compatibility beats a stale registry)
+KNOWN_ANOMALY_KINDS = (
+    "nan_loss", "step_time_regression", "reader_lag", "serve_shed",
+    "ckpt_integrity", "injected_fault",
+    # serving replica tier (dtf_tpu/serve/router.py)
+    "router_shed", "replica_lost", "replica_give_up",
+    "redispatch_divergence", "router_deadline",
+    # raw chaos kinds (the fault_kind attr of injected_fault records;
+    # accepted so `--allow replica_kill`-style typos warn, not pass)
+    "replica_kill", "net_partition", "slow_replica",
+)
+
+
 def discover(paths: List[str]) -> List[str]:
-    """Expand directories to their trace_rank*.jsonl files."""
+    """Expand directories to their trace files: per-rank
+    ``trace_rank*.jsonl`` plus named streams (``trace_router*.jsonl``,
+    the serving router's tier)."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            found = sorted(glob.glob(os.path.join(p, "trace_rank*.jsonl")))
+            found = sorted(
+                glob.glob(os.path.join(p, "trace_rank*.jsonl"))
+                + glob.glob(os.path.join(p, "trace_router*.jsonl")))
             if not found:
                 raise FileNotFoundError(
                     f"no trace_rank*.jsonl files under {p!r}")
@@ -60,12 +81,16 @@ def discover(paths: List[str]) -> List[str]:
     return files
 
 
-def _rank_from_path(path: str) -> int:
+def _rank_from_path(path: str):
     # the writer's naming contract, not "any digits": a rotated
     # trace_rank2.jsonl.1 or a v4_trace_rank2.jsonl prefix must still
-    # resolve rank 2
-    m = re.search(r"trace_rank(\d+)", os.path.basename(path))
-    return int(m.group(1)) if m else 0
+    # resolve rank 2; named streams resolve to their name
+    base = os.path.basename(path)
+    m = re.search(r"trace_rank(\d+)", base)
+    if m:
+        return int(m.group(1))
+    m = re.search(r"trace_([A-Za-z]\w*)", base)
+    return m.group(1) if m else 0
 
 
 def merge_records(files: List[str]) -> List[dict]:
@@ -79,8 +104,10 @@ def merge_records(files: List[str]) -> List[dict]:
         for rec in read_records(path):
             rec.setdefault("rank", fallback)
             merged.append(rec)
+    # ties break by rank-as-string: int ranks and named streams
+    # ("router") share one timeline
     merged.sort(key=lambda r: (float(r.get("ts", 0.0)),
-                               int(r.get("rank", 0))))
+                               str(r.get("rank", 0))))
     return merged
 
 
@@ -116,7 +143,7 @@ def summarize(files: List[str]) -> dict:
         }
     return {
         "files": files,
-        "ranks": sorted(ranks),
+        "ranks": sorted(ranks, key=str),
         "step_spans": len(steps) if steps else (
             span_rows.get("step", {}).get("count", 0)),
         "spans": span_rows,
@@ -173,6 +200,13 @@ def main(argv=None) -> int:
 
     files = discover(args.paths)
     allowed = set(args.allow)
+    for kind in sorted(allowed - set(KNOWN_ANOMALY_KINDS)):
+        # warn, don't fail: new subsystems may emit kinds this registry
+        # hasn't learned — but a typo'd --allow silently tolerating
+        # nothing is exactly the bug an expected-anomaly list invites
+        print(f"warning: --allow {kind!r} is not a known anomaly kind "
+              f"(known: {', '.join(KNOWN_ANOMALY_KINDS)})",
+              file=sys.stderr)
     if args.merge:
         # one pass over the files: the merged stream also feeds the
         # --check anomaly scan (no summarize — the aggregate view is

@@ -277,6 +277,43 @@ class Config:
     # one shared-page write (serve/engine.py module docs)
     serve_prefix_sharing: bool = True
 
+    # --- serving replica tier (serve/router.py over cli/replica_main) ---
+    # replica serve processes behind the router (cli/router_main.py);
+    # each is a full ServeEngine (optionally TP-sharded via --serve_tp)
+    router_replicas: int = 2
+    # default per-request deadline: the router resolves every accepted
+    # request — tokens, Backpressure, or DeadlineExceeded — within it
+    router_deadline_s: float = 120.0
+    # router-level admission bound: outstanding (queued + in-flight)
+    # requests beyond this shed loudly with Backpressure(retry_after)
+    router_admission: int = 128
+    # health-probe cadence (reads each replica's heartbeat_rank{K}.json)
+    router_probe_s: float = 0.5
+    # heartbeat silence past this = the replica is declared lost (its
+    # in-flight re-dispatches; must be comfortably > --heartbeat_secs)
+    router_health_timeout_s: float = 15.0
+    # per-replica in-flight dispatch cap; 0 = auto (serve_queue_size)
+    router_replica_inflight: int = 0
+    # replica respawn budget: at most this many respawns per sliding
+    # window, exponential backoff between them, then loud give-up —
+    # the launcher supervisor's crash discipline, per replica
+    router_max_respawns: int = 8
+    router_respawn_window_s: float = 300.0
+    router_respawn_backoff_s: float = 0.5
+    # hedge: re-dispatch a request to a second replica when its first
+    # makes no progress for this long (greedy decode makes the copies
+    # token-identical; first done wins).  0 = off
+    router_hedge_s: float = 0.0
+    # placement policy: prefix-affine (route by chained prompt-page
+    # digest to the replica whose PrefixRegistry is warm, least-loaded
+    # fallback) | least_loaded | random (the bench A/B arm)
+    router_placement: str = "affinity"
+    # rendezvous directory for announce + heartbeat files (router +
+    # cli/replica_main); "" = router_main picks a temp dir
+    rendezvous_dir: str = ""
+    # replica identity for cli/replica_main; -1 = from DTF_PROCESS_ID
+    replica_id: int = -1
+
     # --- parallelism planner (dtf_tpu/plan) ---
     # "" = off (hand-set flags rule, the pre-planner behavior);
     # "auto" = search the feasible plan lattice on --plan_mesh and
@@ -338,9 +375,13 @@ class Config:
     # --- chaos (dtf_tpu/chaos: deterministic fault injection) ---
     # comma-separated fault specs, e.g. "crash@step:120",
     # "sigterm@rank1:step:80", "ps_drop@version:50",
-    # "heartbeat_stall@step:60", "ckpt_truncate@latest".  "" = off (the
-    # DTF_FAULT env var also arms it).  Provably zero-cost when unset:
-    # every probe is a module-level None check (tests/test_chaos.py)
+    # "heartbeat_stall@step:60", "ckpt_truncate@latest"; serving
+    # replica tier: "replica_kill@req:6" (router SIGKILLs the Nth
+    # dispatch's replica), "net_partition@replica1:12" (drop replica
+    # 1's health probes for 12 prober ticks), "slow_replica@replica1:4"
+    # (4x decode steps in replica 1).  "" = off (the DTF_FAULT env var
+    # also arms it).  Provably zero-cost when unset: every probe is a
+    # module-level None check (tests/test_chaos.py)
     fault: str = ""
 
     # --- misc ---
@@ -434,6 +475,41 @@ class Config:
                 "serve_tp > 1 (tensor-parallel serving) needs the paged "
                 "KV cache (kv_page_size > 0) — the page pool is the "
                 "layout that shards")
+        if self.router_replicas < 1:
+            raise ValueError(
+                f"router_replicas must be >= 1, got {self.router_replicas}")
+        if self.router_deadline_s <= 0 or self.router_admission < 1:
+            raise ValueError(
+                "router_deadline_s must be > 0 and router_admission >= 1")
+        if self.router_probe_s <= 0 or (
+                self.router_probe_s >= self.router_health_timeout_s):
+            raise ValueError(
+                f"router_probe_s ({self.router_probe_s}) must be > 0 and "
+                f"< router_health_timeout_s "
+                f"({self.router_health_timeout_s}) — a health verdict "
+                f"needs multiple probe ticks")
+        if self.router_health_timeout_s <= 0:
+            raise ValueError(
+                f"router_health_timeout_s must be > 0, got "
+                f"{self.router_health_timeout_s}")
+        # NOTE: health_timeout vs heartbeat_secs is cross-checked in
+        # cli/router_main.py, not here — a training-only run raising
+        # --heartbeat_secs must not be rejected over router defaults
+        # it never uses
+        # literal copy of serve/router.py PLACEMENTS: Config must import
+        # without pulling the serve stack (flax models); parity is
+        # pinned by tests/test_router.py
+        if self.router_placement not in ("affinity", "least_loaded",
+                                         "random"):
+            raise ValueError(
+                f"unknown router_placement {self.router_placement!r}; "
+                f"choose from ('affinity', 'least_loaded', 'random')")
+        if (self.router_replica_inflight < 0 or self.router_max_respawns
+                < 0 or self.router_respawn_backoff_s < 0
+                or self.router_hedge_s < 0):
+            raise ValueError(
+                "router_replica_inflight/router_max_respawns/"
+                "router_respawn_backoff_s/router_hedge_s must be >= 0")
         if self.step_time_guard_factor and self.step_time_guard_factor <= 1.0:
             raise ValueError(
                 f"step_time_guard_factor must be > 1.0 (or 0 to disable), "

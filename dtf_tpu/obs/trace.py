@@ -92,9 +92,12 @@ class _Span:
 class Tracer:
     """Buffered JSONL writer; thread-safe; one instance per process."""
 
-    def __init__(self, path: str, rank: int = 0, flush_every: int = 256):
+    def __init__(self, path: str, rank=0, flush_every: int = 256):
         self.path = os.path.abspath(path)
-        self.rank = int(rank)
+        # rank is an int for launcher ranks; NAMED streams (the serving
+        # router) tag records with their stream name instead, so a
+        # merged timeline reads "router" next to 0..N-1
+        self.rank = rank if isinstance(rank, str) else int(rank)
         self.flush_every = max(int(flush_every), 1)
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._file = open(self.path, "a", buffering=1024 * 64)
@@ -156,13 +159,24 @@ class Tracer:
 # ---------------------------------------------------------------------------
 
 def configure(trace_dir: str, rank: Optional[int] = None,
-              flush_every: int = 256) -> Tracer:
+              flush_every: int = 256,
+              stream: Optional[str] = None) -> Tracer:
     """Install the process-global tracer writing under ``trace_dir``.
-    Idempotent per (dir, rank): reconfiguring replaces the tracer."""
+    Idempotent per (dir, rank): reconfiguring replaces the tracer.
+
+    ``stream`` names a NON-RANK stream: the file becomes
+    ``trace_<stream>.jsonl`` and records are tagged with the stream
+    name — the serving router writes ``trace_router.jsonl`` next to
+    its replicas' ``trace_rank{K}.jsonl`` so ``trace_main --merge``
+    interleaves the tiers into one timeline."""
     global _tracer
-    if rank is None:
-        rank = int(os.environ.get("DTF_PROCESS_ID", "0"))
-    path = os.path.join(trace_dir, f"trace_rank{rank}.jsonl")
+    if stream is not None:
+        path = os.path.join(trace_dir, f"trace_{stream}.jsonl")
+        rank = stream
+    else:
+        if rank is None:
+            rank = int(os.environ.get("DTF_PROCESS_ID", "0"))
+        path = os.path.join(trace_dir, f"trace_rank{rank}.jsonl")
     with _lock:
         if _tracer is not None:
             if _tracer.path == os.path.abspath(path):

@@ -101,6 +101,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from dtf_tpu import chaos
 from dtf_tpu.obs import trace
 from dtf_tpu.obs.registry import MetricsRegistry
 from dtf_tpu.serve.decode import Decoder
@@ -435,7 +436,15 @@ class ServeEngine:
     ``prefix_sharing`` (paged mode, default on) shares full
     prompt-prefix pages across requests via the refcounted pool +
     prefix registry (module docstring).  ``mesh`` selects
-    tensor-parallel decode (paged mode; serve/decode.py Decoder)."""
+    tensor-parallel decode (paged mode; serve/decode.py Decoder).
+
+    ``heartbeat`` (obs.watchdog.Heartbeat) is beaten once per ENGINE
+    ITERATION with step = completed-request count — serving liveness
+    for the launcher's hang watchdog and the router's health probe.
+    Beating from the engine loop (not a side thread) is the point: a
+    deadlocked engine thread stops beating, which is exactly the
+    signal a health checker needs (the chatty-deadlock case a log- or
+    thread-alive check misses)."""
 
     def __init__(self, model, params, *, max_batch: int = 8,
                  max_seq_len: Optional[int] = None,
@@ -443,7 +452,8 @@ class ServeEngine:
                  seed: int = 0, kv_page_size: Optional[int] = 16,
                  kv_pool_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 prefix_sharing: bool = True, mesh=None):
+                 prefix_sharing: bool = True, mesh=None,
+                 heartbeat=None):
         if max_batch < 1 or queue_size < 1:
             raise ValueError("max_batch and queue_size must be >= 1")
         self.max_batch = int(max_batch)
@@ -556,6 +566,7 @@ class ServeEngine:
         # streaming: engine-emit → consumer-receive delay per token
         self._m_stream_lag = self.metrics.histogram("serve_stream_lag_s",
                                                     unit="s")
+        self._heartbeat = heartbeat
         self._last_step_t: Optional[float] = None
         self._prefill_rr = -1           # round-robin cursor (chunk sched)
         self.max_concurrent = 0         # peak simultaneously-active slots
@@ -569,6 +580,14 @@ class ServeEngine:
         """Total requests shed (single source of truth: the registry
         counter the benchmark export reads)."""
         return self._m_shed.value
+
+    @property
+    def outstanding(self) -> int:
+        """Queued + in-flight requests — the load number a router's
+        least-loaded placement and a replica's stats report expose."""
+        with self._cond:
+            return (len(self._pending)
+                    + sum(s is not None for s in self._slots))
 
     def reset_measurement(self) -> int:
         """Zero the peak/distribution measurement state (decode-gap
@@ -689,6 +708,10 @@ class ServeEngine:
 
     def _loop_body(self):
         while True:
+            if self._heartbeat is not None:
+                # serving liveness: the beat interval gate is inside
+                # beat(), so this is one clock read per iteration
+                self._heartbeat.beat(step=self._m_completed.value)
             with self._cond:
                 active = any(s is not None for s in self._slots)
                 if not self._pending and not active:
@@ -975,7 +998,15 @@ class ServeEngine:
                 self._cache, tokens, index, temps, sub,
                 block_tables=tables)
             out = np.asarray(out)
-        self._m_step_time.observe(time.perf_counter() - now)
+        step_dt = time.perf_counter() - now
+        self._m_step_time.observe(step_dt)
+        # chaos slow_replica@replica<K>:<F>: stretch each decode step to
+        # F× its measured time — the straggler-replica signature the
+        # router's deadline + least-loaded placement must absorb.  A
+        # None-check when chaos is off, like every probe.
+        slow = chaos.slow_replica()
+        if slow > 1.0:
+            time.sleep((slow - 1.0) * step_dt)
         for i, s in enumerate(self._slots):
             if s is None or s.phase != "decode":
                 continue

@@ -1,0 +1,282 @@
+"""Replica-side serve server: one ServeEngine behind a local socket.
+
+The serving replica tier (serve/router.py) is N of these processes
+behind a router.  Each replica owns a full :class:`ServeEngine`
+(optionally TP-sharded — the engine doesn't know it's a replica) and
+speaks a newline-delimited-JSON wire protocol over a loopback TCP
+socket:
+
+  router → replica
+    {"op":"submit","id":W,"prompt":[...],"max_new_tokens":N,
+     "temperature":T,"eos_id":E}        dispatch one request
+    {"op":"drain"}                      stop admissions, finish in-flight
+    {"op":"stats"}                      request a stats snapshot
+
+  replica → router
+    {"op":"token","id":W,"token":T,"i":I}   token I of request W retired
+    {"op":"done","id":W,"tokens":[...],...} request W finished
+    {"op":"backpressure","id":W,"retry_after":S}  engine shed it
+    {"op":"error","id":W,"error":MSG}       engine rejected it
+    {"op":"stats",...}                      stats snapshot
+
+RENDEZVOUS is file-based, deliberately: the replica binds an EPHEMERAL
+port (no port-allocation coordination, no TOCTOU between picking and
+binding) and atomically writes ``replica_rank{K}.json`` — {"port",
+"pid", "generation", "ts"} — into the shared rendezvous directory.
+The router polls that file to (re)connect, so a RESPAWNED replica
+re-registers by construction: new process, new port, new announce
+content, same path.  Liveness travels separately, through the obs
+heartbeat files (``heartbeat_rank{K}.json``) the engine rewrites every
+iteration — the router's health probe reads those, never the socket,
+so a wedged replica with a healthy TCP stack still reads as dead.
+
+The engine is duck-typed (``submit``/``begin_drain``/``outstanding``):
+tests drive the full wire protocol against a deterministic fake engine
+with no jax in the process, and the subprocess entry
+(cli/replica_main.py) passes the real thing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue as queue_mod
+import socket
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from dtf_tpu.serve.engine import Backpressure
+
+log = logging.getLogger("dtf_tpu")
+
+
+def announce_path(rendezvous_dir: str, replica_id: int) -> str:
+    return os.path.join(rendezvous_dir, f"replica_rank{replica_id}.json")
+
+
+def read_announce(rendezvous_dir: str, replica_id: int) -> Optional[dict]:
+    """Parse a replica's announce file; None when missing/torn (the
+    router treats that as 'not yet registered', not as an error)."""
+    try:
+        with open(announce_path(rendezvous_dir, replica_id)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def send_msg(wfile, lock: threading.Lock, obj: dict) -> None:
+    """One JSON line, atomically w.r.t. other senders on this socket."""
+    data = (json.dumps(obj) + "\n").encode()
+    with lock:
+        wfile.write(data)
+        wfile.flush()
+
+
+class ReplicaServer:
+    """Serve one engine over a loopback socket + announce file.
+
+    ``engine`` needs ``submit(prompt, max_new_tokens, temperature,
+    eos_id, on_token) -> handle`` (handle: ``result(timeout)`` →
+    object with ``.tokens``/``.cancelled``), ``begin_drain()`` and
+    ``outstanding``; :class:`~dtf_tpu.serve.engine.ServeEngine`
+    satisfies it, and the router tests use a jax-free fake."""
+
+    def __init__(self, engine, replica_id: int, rendezvous_dir: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 result_timeout_s: float = 600.0):
+        self.engine = engine
+        self.replica_id = int(replica_id)
+        self.rendezvous_dir = os.path.abspath(rendezvous_dir)
+        self.result_timeout_s = float(result_timeout_s)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(8)
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: list = []
+
+    # -- rendezvous ----------------------------------------------------
+    def _announce(self) -> None:
+        os.makedirs(self.rendezvous_dir, exist_ok=True)
+        payload = {
+            "port": self.port,
+            "pid": os.getpid(),
+            "generation": int(os.environ.get("DTF_RESTART_GENERATION",
+                                             "0")),
+            "ts": time.time(),
+        }
+        path = announce_path(self.rendezvous_dir, self.replica_id)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)   # atomic: the router never reads a torn file
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "ReplicaServer":
+        self._announce()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"replica{self.replica_id}-accept")
+        self._accept_thread.start()
+        log.info("replica %d: serving on 127.0.0.1:%d (rendezvous %s)",
+                 self.replica_id, self.port, self.rendezvous_dir)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- connection handling -------------------------------------------
+    def _accept_loop(self) -> None:
+        # multiple concurrent connections are allowed: after a
+        # partition the router reconnects while its old (half-dead)
+        # connection may still exist — responses go to the connection
+        # their submit arrived on, and writes to a closed one are
+        # dropped (the router re-dispatched those requests anyway)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=f"replica{self.replica_id}-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        outq: "queue_mod.Queue" = queue_mod.Queue()
+        dead = threading.Event()
+        wlock = threading.Lock()
+
+        def writer():
+            while True:
+                item = outq.get()
+                if item is None:
+                    return
+                try:
+                    send_msg(wfile, wlock, item)
+                except (OSError, ValueError):
+                    # router gone (or going): stop queuing work for a
+                    # dead pipe; in-flight engine work keeps running —
+                    # the router re-dispatches what it still wants
+                    dead.set()
+                    return
+
+        wthread = threading.Thread(
+            target=writer, daemon=True,
+            name=f"replica{self.replica_id}-writer")
+        wthread.start()
+        try:
+            for line in rfile:
+                if self._stop.is_set():
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    log.warning("replica %d: bad wire line %r",
+                                self.replica_id, line[:80])
+                    continue
+                op = msg.get("op")
+                if op == "submit":
+                    self._handle_submit(msg, outq, dead)
+                elif op == "drain":
+                    self.engine.begin_drain()
+                elif op == "stats":
+                    stats = self._stats()
+                    stats["tag"] = msg.get("tag", "")
+                    outq.put(stats)
+                else:
+                    log.warning("replica %d: unknown op %r",
+                                self.replica_id, op)
+        except OSError:
+            pass
+        finally:
+            dead.set()
+            outq.put(None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def _stats(self) -> dict:
+        out = {"op": "stats", "replica": self.replica_id,
+               "outstanding": int(getattr(self.engine, "outstanding", 0)),
+               "pid": os.getpid()}
+        metrics = getattr(self.engine, "metrics", None)
+        if metrics is not None:
+            for name in ("serve_completed_total", "serve_shed_total",
+                         "serve_prefix_hit_pages_total",
+                         "serve_prefix_cow_total"):
+                m = metrics.get(name)
+                if m is not None:
+                    out[name] = m.value
+        return out
+
+    def _handle_submit(self, msg: dict, outq, dead: threading.Event):
+        wire_id = msg["id"]
+        counter = {"i": 0}
+
+        def on_token(tok: int) -> None:
+            # engine thread: per-request tokens retire sequentially, so
+            # the unsynchronized counter is safe
+            if dead.is_set():
+                return
+            i = counter["i"]
+            counter["i"] = i + 1
+            outq.put({"op": "token", "id": wire_id, "token": int(tok),
+                      "i": i})
+
+        try:
+            handle = self.engine.submit(
+                np.asarray(msg["prompt"], np.int32),
+                max_new_tokens=int(msg.get("max_new_tokens", 32)),
+                temperature=float(msg.get("temperature", 0.0)),
+                eos_id=msg.get("eos_id"),
+                on_token=on_token)
+        except Backpressure as bp:
+            outq.put({"op": "backpressure", "id": wire_id,
+                      "retry_after": float(bp.retry_after)})
+            return
+        except Exception as e:  # noqa: BLE001 — a malformed request
+            # must fail ITS caller, never the wire loop
+            outq.put({"op": "error", "id": wire_id, "error": str(e)})
+            return
+
+        def waiter():
+            try:
+                r = handle.result(timeout=self.result_timeout_s)
+            except Exception as e:  # noqa: BLE001
+                outq.put({"op": "error", "id": wire_id, "error": str(e)})
+                return
+            outq.put({"op": "done", "id": wire_id,
+                      "tokens": [int(t) for t in r.tokens],
+                      "cancelled": bool(r.cancelled),
+                      "prompt_len": int(r.prompt_len),
+                      "latency_s": float(r.latency_s)})
+
+        threading.Thread(target=waiter, daemon=True,
+                         name=f"replica{self.replica_id}-wait").start()

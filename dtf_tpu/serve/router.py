@@ -1,0 +1,1002 @@
+"""Serving replica tier: a health-checked router over N replica serve
+processes.
+
+PR 7 made one serving process multi-chip (TP shards the model); heavy
+traffic needs many serving PROCESSES.  This module is the front-end
+that owns the client-facing queue and fans requests out to N replicas
+(each a full ServeEngine behind serve/replica.py's wire protocol),
+with failure handling as first-class contracts rather than an operator
+reading ``log7.log``:
+
+  placement — PREFIX-AFFINE by default: requests are routed by the
+      chained prefix digest of their full prompt pages (the same
+      digest chain the engine's PrefixRegistry keys on), so traffic
+      sharing a system prompt lands on the replica whose registry is
+      already warm — a prefix hit there costs zero prefill pages,
+      while scattering the same traffic re-prefills the prompt once
+      per replica.  Fallback (and tie-break) is least-loaded; a
+      ``random`` policy exists for the bench A/B.
+  health — per-replica liveness comes from the obs heartbeat files
+      (``heartbeat_rank{K}.json``) the replica's ENGINE LOOP rewrites,
+      read by a prober at a fixed tick — never from the socket, so a
+      wedged replica with a healthy TCP stack still reads as dead, and
+      a network partition (probes dropped, process fine) reads exactly
+      like a stall: silence.  The announce file (``replica_rank{K}
+      .json``, ephemeral port + pid) is the re-registration channel: a
+      respawned or healed replica re-registers by rewriting it.
+  deadlines — every request carries one; a scan at dispatch-loop
+      cadence fails overdue requests with :class:`DeadlineExceeded`.
+      Degrade, never hang: every accepted request resolves — tokens,
+      Backpressure, or DeadlineExceeded — within its deadline.
+  retry / failover — a dead or unreachable replica's in-flight
+      requests re-dispatch transparently with exponential backoff.
+      Decode is deterministic (greedy), so a re-dispatched request
+      reproduces its token stream exactly; the router dedupes by token
+      index (already-delivered tokens are verified, not re-emitted) so
+      a client stream sees each token once.  A divergence (sampled
+      requests re-dispatch with a different engine RNG) is counted and
+      flagged, never silently mixed.
+  backpressure — a replica's ``Backpressure(retry_after)`` marks it
+      saturated until retry_after and the request tries its siblings
+      ONCE each; when every live replica has shed it, the Backpressure
+      propagates to the client instead of becoming a router retry
+      storm.  A router-level admission bound sheds new submits loudly
+      (``router_shed`` anomaly) when the outstanding count hits it.
+  respawn — when the router owns the replica processes, a dead one
+      respawns under the PR-4 supervisor discipline: a sliding-window
+      budget with exponential backoff, then loud give-up.  The fresh
+      process re-announces (new port, same file) and the prober folds
+      it back in.
+
+Chaos composes (dtf_tpu/chaos): ``replica_kill@req:N`` SIGKILLs a
+replica at the Nth dispatch, ``net_partition@replica<K>:<ticks>``
+drops K's health probes for that many prober ticks (timeouts, not
+clean exits), ``slow_replica@replica<K>:<factor>`` stretches K's
+decode steps.  tools/router_smoke.py drives the matrix and pins
+token-exactness + zero lost requests (ci_check stage 9).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import os
+import queue as queue_mod
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dtf_tpu import chaos
+from dtf_tpu.obs import trace
+from dtf_tpu.obs.registry import MetricsRegistry
+from dtf_tpu.obs.watchdog import heartbeat_path, read_heartbeat
+from dtf_tpu.serve.engine import Backpressure, _page_digest
+from dtf_tpu.serve.replica import read_announce, send_msg
+
+log = logging.getLogger("dtf_tpu")
+
+PLACEMENTS = ("affinity", "least_loaded", "random")
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request did not finish inside its deadline.  The router
+    resolves it LOUDLY at the deadline instead of letting the client
+    wait on a promise nobody is working on."""
+
+    def __init__(self, request_id: int, deadline_s: float, detail: str = ""):
+        super().__init__(
+            f"request {request_id} exceeded its {deadline_s:.1f}s "
+            f"deadline{': ' + detail if detail else ''}")
+        self.request_id = request_id
+        self.deadline_s = deadline_s
+
+
+@dataclasses.dataclass
+class RouterResult:
+    request_id: int
+    tokens: List[int]
+    prompt_len: int
+    latency_s: float
+    replica: int                 # replica that completed it
+    redispatches: int            # failover count this request survived
+    diverged: bool               # re-dispatched tokens mismatched the
+                                 # already-delivered prefix (sampled
+                                 # requests only; greedy never)
+    submit_time: float = 0.0
+    finish_time: float = 0.0
+
+
+class RouterHandle:
+    """Future-lite for one routed request: ``result()`` blocks (raising
+    Backpressure/DeadlineExceeded when that's how it resolved);
+    ``stream()`` yields tokens as replicas deliver them, each exactly
+    once across failovers."""
+
+    def __init__(self, req: "_Request"):
+        self.request = req
+        self._event = threading.Event()
+        self._result: Optional[RouterResult] = None
+        self._exc: Optional[BaseException] = None
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> RouterResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.id} not resolved in {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def stream(self, timeout: Optional[float] = None):
+        """Iterator over tokens; ends when the request resolves.  A
+        request that resolved in failure raises its exception here
+        too, so a streaming consumer cannot mistake a shed request
+        for a short answer."""
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    f"request {self.request.id}: no token in {timeout}s"
+                ) from None
+            if kind == "done":
+                if self._exc is not None:
+                    raise self._exc
+                return
+            yield payload
+
+    # router-side delivery (under the router lock)
+    def _emit(self, token: int) -> None:
+        self._q.put(("token", int(token)))
+
+    def _deliver(self, result: RouterResult) -> None:
+        self._result = result
+        self._event.set()
+        self._q.put(("done", None))
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+        self._q.put(("done", None))
+
+
+class _Request:
+    __slots__ = ("id", "prompt", "max_new_tokens", "temperature",
+                 "eos_id", "deadline", "deadline_s", "digests", "handle",
+                 "delivered", "attempt", "next_try", "active",
+                 "bp_replicas", "redispatches", "diverged", "done",
+                 "submit_time", "last_dispatch", "last_progress")
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
+                 temperature: float, eos_id, deadline_s: float,
+                 digests: List[str]):
+        self.id = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.deadline_s = deadline_s
+        self.submit_time = time.time()
+        self.deadline = time.monotonic() + deadline_s
+        self.digests = digests
+        self.handle = RouterHandle(self)
+        self.delivered: List[int] = []
+        self.attempt = 0
+        self.next_try = 0.0
+        self.active: Dict[str, int] = {}   # wire_id -> replica id
+        self.bp_replicas: set = set()
+        self.redispatches = 0
+        self.diverged = False
+        self.done = False
+        self.last_dispatch = 0.0
+        self.last_progress = 0.0
+
+
+class _Replica:
+    """Router-side state for one replica."""
+
+    def __init__(self, rid: int, rendezvous_dir: str):
+        self.id = rid
+        self.rendezvous_dir = rendezvous_dir
+        self.proc: Optional[subprocess.Popen] = None
+        self.generation = 0
+        self.port: Optional[int] = None
+        self.announced_pid: Optional[int] = None
+        self.conn: Optional[socket.socket] = None
+        self.wfile = None
+        self.wlock = threading.Lock()
+        self.healthy = False
+        self.gave_up = False
+        self.inflight: Dict[str, _Request] = {}
+        self.saturated_until = 0.0
+        self.last_beat_mono = time.monotonic()
+        self.last_beat_ts = None
+        self.hb_mtime = None
+        self.respawn_times: collections.deque = collections.deque()
+        self.respawn_at: Optional[float] = None
+        self.completed = 0
+        self.last_stats: Dict[str, dict] = {}   # tag -> stats msg
+
+
+class Router:
+    """The replica-tier front-end.  See the module docstring.
+
+    ``spawn`` is a callable ``(replica_id, generation) -> Popen`` that
+    starts one replica process (see :func:`replica_spawner`); None
+    means the replicas are managed externally (tests, or an operator
+    supervising them separately) — the router then only connects,
+    probes, and fails over, and ``kill_hook`` (tests) stands in for
+    SIGKILL when chaos wants a replica dead."""
+
+    def __init__(self, num_replicas: int, rendezvous_dir: str, *,
+                 spawn: Optional[Callable] = None,
+                 page_size: int = 16,
+                 placement: str = "affinity",
+                 deadline_s: float = 120.0,
+                 admission_limit: int = 128,
+                 probe_interval_s: float = 0.25,
+                 health_timeout_s: float = 15.0,
+                 replica_inflight: int = 16,
+                 retry_backoff_s: float = 0.05,
+                 max_retry_backoff_s: float = 2.0,
+                 max_respawns: int = 8,
+                 respawn_window_s: float = 300.0,
+                 respawn_backoff_s: float = 0.5,
+                 hedge_s: float = 0.0,
+                 kill_hook: Optional[Callable] = None,
+                 seed: int = 0):
+        if num_replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {num_replicas}")
+        if placement not in PLACEMENTS:
+            raise ValueError(f"unknown placement {placement!r}; choose "
+                             f"from {PLACEMENTS}")
+        if probe_interval_s >= health_timeout_s:
+            raise ValueError(
+                f"probe_interval_s ({probe_interval_s}) must be < "
+                f"health_timeout_s ({health_timeout_s}) — a health "
+                f"verdict needs multiple probe ticks")
+        self.rendezvous_dir = os.path.abspath(rendezvous_dir)
+        os.makedirs(self.rendezvous_dir, exist_ok=True)
+        self._spawn = spawn
+        self.page_size = int(page_size)
+        self.placement = placement
+        self.deadline_s = float(deadline_s)
+        self.admission_limit = int(admission_limit)
+        self.probe_interval_s = float(probe_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.replica_inflight = int(replica_inflight)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.max_retry_backoff_s = float(max_retry_backoff_s)
+        self.max_respawns = int(max_respawns)
+        self.respawn_window_s = float(respawn_window_s)
+        self.respawn_backoff_s = float(respawn_backoff_s)
+        self.hedge_s = float(hedge_s)
+        self._kill_hook = kill_hook
+        self._rng = np.random.default_rng(seed)
+
+        self._mu = threading.Condition()
+        self._replicas = [_Replica(i, self.rendezvous_dir)
+                          for i in range(int(num_replicas))]
+        self._queue: List[_Request] = []
+        self._live: Dict[int, _Request] = {}
+        self._outstanding = 0
+        self._ids = 0
+        self._dispatch_seq = 0
+        self._draining = False
+        self._stopping = False
+        self._ewma_latency = 0.5
+        # digest -> replica id, insertion-ordered and BOUNDED: routing
+        # state must not grow with total traffic (the replica-side
+        # registry it mirrors is bounded by pool pages; stale owners
+        # only cost a least-loaded fallback)
+        self._prefix_owner: Dict[str, int] = {}
+        self._prefix_owner_cap = 65536
+        self._stats_events: Dict[str, threading.Event] = {}
+
+        # obs registry: the router's operational vocabulary
+        self.metrics = MetricsRegistry()
+        m = self.metrics
+        self._m_queue_depth = m.gauge("router_queue_depth", unit="requests")
+        self._m_inflight = m.gauge("router_inflight", unit="requests")
+        self._m_dispatch = m.counter("router_dispatch_total",
+                                     unit="requests")
+        self._m_completed = m.counter("router_completed_total",
+                                      unit="requests")
+        self._m_shed = m.counter("router_shed_total", unit="requests")
+        self._m_bp_relayed = m.counter("router_backpressure_relayed_total",
+                                       unit="requests")
+        self._m_failover = m.counter("router_failover_total",
+                                     unit="requests")
+        self._m_hedge = m.counter("router_hedge_total", unit="requests")
+        self._m_deadline = m.counter("router_deadline_exceeded_total",
+                                     unit="requests")
+        self._m_affinity_hit = m.counter("router_affinity_hits_total",
+                                         unit="requests")
+        self._m_affinity_miss = m.counter("router_affinity_miss_total",
+                                          unit="requests")
+        self._m_stale = m.counter("router_stale_msgs_total", unit="msgs")
+        self._m_diverged = m.counter("router_redispatch_divergence_total",
+                                     unit="requests")
+        self._m_respawns = m.counter("router_replica_respawns_total",
+                                     unit="replicas")
+        self._m_latency = m.histogram("router_latency_s", unit="s")
+        self._m_health = [m.gauge(f"router_replica{i}_healthy",
+                                  unit="bool")
+                          for i in range(int(num_replicas))]
+
+        self._threads: List[threading.Thread] = []
+        self._started = False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, wait_s: float = 0.0) -> "Router":
+        """Spawn replicas (proc mode), start the dispatcher + prober.
+        ``wait_s`` > 0 blocks until every replica is healthy (raises
+        on timeout) — the smoke/bench posture; 0 returns immediately
+        and traffic queues until replicas register."""
+        if self._started:
+            raise RuntimeError("router already started")
+        self._started = True
+        if self._spawn is not None:
+            from dtf_tpu.serve.replica import announce_path
+            for r in self._replicas:
+                # a heartbeat/announce surviving a previous run must not
+                # masquerade as this generation's registration
+                for path in (heartbeat_path(self.rendezvous_dir, r.id),
+                             announce_path(self.rendezvous_dir, r.id)):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                r.proc = self._spawn(r.id, r.generation)
+        for name, fn in (("router-dispatch", self._dispatch_loop),
+                         ("router-probe", self._probe_loop)):
+            t = threading.Thread(target=fn, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        if wait_s > 0:
+            deadline = time.monotonic() + wait_s
+            while time.monotonic() < deadline:
+                with self._mu:
+                    if all(r.healthy for r in self._replicas):
+                        return self
+                time.sleep(0.05)
+            with self._mu:
+                unhealthy = [r.id for r in self._replicas if not r.healthy]
+            # a failed start must not leak the tier it spawned: N jax
+            # serve processes surviving a TimeoutError would starve the
+            # host for whatever runs next
+            self.stop(drain=False)
+            raise TimeoutError(
+                f"replicas {unhealthy} not healthy after {wait_s:.0f}s "
+                f"(no heartbeat/announce under {self.rendezvous_dir})")
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting; queued + in-flight traffic still resolves."""
+        self._draining = True
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        if drain:
+            self.begin_drain()
+            deadline = time.monotonic() + timeout
+            with self._mu:
+                while self._outstanding > 0 and time.monotonic() < deadline:
+                    self._mu.wait(timeout=0.1)
+        with self._mu:
+            self._stopping = True
+            stranded = list(self._live.values())
+            self._queue.clear()
+            self._live.clear()
+            for req in stranded:
+                if not req.done:
+                    req.done = True
+                    req.handle._fail(RuntimeError("router stopped"))
+            self._outstanding = 0
+            self._mu.notify_all()
+        for r in self._replicas:
+            self._close_conn(r)
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.terminate()   # SIGTERM: replicas drain + exit 0
+        for r in self._replicas:
+            if r.proc is not None:
+                try:
+                    r.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    r.proc.kill()
+                    r.proc.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=False)
+
+    # -- client side ---------------------------------------------------
+    @property
+    def outstanding(self) -> int:
+        with self._mu:
+            return self._outstanding
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               temperature: float = 0.0, eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> RouterHandle:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        deadline_s = float(deadline_s if deadline_s is not None
+                           else self.deadline_s)
+        if deadline_s <= 0:
+            raise ValueError(f"deadline must be positive, got {deadline_s}")
+        digests = self._digest_chain(prompt)
+        with self._mu:
+            if self._stopping:
+                raise RuntimeError("router is stopped")
+            if self._draining or self._outstanding >= self.admission_limit:
+                self._m_shed.inc()
+                retry = max(0.05, self._ewma_latency
+                            * (1 + self._outstanding
+                               / max(1, self.admission_limit)))
+                reason = ("draining" if self._draining else
+                          f"admission limit {self.admission_limit}")
+                log.error("router: shedding request (%s; %d outstanding; "
+                          "retry_after=%.2fs)", reason, self._outstanding,
+                          retry)
+                trace.anomaly("router_shed", reason=reason,
+                              outstanding=self._outstanding,
+                              retry_after=retry)
+                raise Backpressure(retry)
+            self._ids += 1
+            req = _Request(self._ids, prompt, int(max_new_tokens),
+                           float(temperature), eos_id, deadline_s, digests)
+            self._queue.append(req)
+            self._live[req.id] = req
+            self._outstanding += 1
+            self._m_queue_depth.set(len(self._queue))
+            self._mu.notify_all()
+        return req.handle
+
+    def generate(self, prompt, **kw) -> RouterResult:
+        return self.submit(prompt, **kw).result(timeout=600)
+
+    # -- placement -----------------------------------------------------
+    def _digest_chain(self, prompt: np.ndarray) -> List[str]:
+        """Chained digests of the prompt's FULL pages — the same chain
+        the replica-side PrefixRegistry keys on, so routing by it is
+        routing to warm registry entries."""
+        ps = self.page_size
+        out: List[str] = []
+        digest = ""
+        for d in range(int(prompt.size) // ps):
+            digest = _page_digest(
+                digest, np.ascontiguousarray(prompt[d * ps:(d + 1) * ps],
+                                             np.int32))
+            out.append(digest)
+        return out
+
+    def _eligible_locked(self, req: _Request, now: float) -> List[_Replica]:
+        return [r for r in self._replicas
+                if not r.gave_up and r.healthy and r.conn is not None
+                and r.saturated_until <= now
+                and r.id not in req.bp_replicas
+                and len(r.inflight) < self.replica_inflight]
+
+    def _place_locked(self, req: _Request,
+                      now: float) -> Optional[_Replica]:
+        eligible = self._eligible_locked(req, now)
+        if not eligible:
+            return None
+        if self.placement == "random":
+            return eligible[int(self._rng.integers(len(eligible)))]
+        if self.placement == "affinity" and req.digests:
+            # deepest registered digest wins: the replica whose
+            # registry holds the longest chain of this prompt
+            for digest in reversed(req.digests):
+                owner = self._prefix_owner.get(digest)
+                if owner is not None:
+                    rep = self._replicas[owner]
+                    if rep in eligible:
+                        self._m_affinity_hit.inc()
+                        return rep
+            self._m_affinity_miss.inc()
+        return min(eligible, key=lambda r: (len(r.inflight), r.id))
+
+    # -- dispatcher ----------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while not self._stopping:
+            with self._mu:
+                self._mu.wait(timeout=0.02)
+                if self._stopping:
+                    return
+                now = time.monotonic()
+                self._check_deadlines_locked(now)
+                for req in list(self._queue):
+                    if req.done or req.next_try > now:
+                        continue
+                    rep = self._place_locked(req, now)
+                    if rep is None:
+                        self._maybe_shed_locked(req, now)
+                        continue
+                    self._queue.remove(req)
+                    self._dispatch_locked(req, rep)
+                if self.hedge_s > 0:
+                    self._maybe_hedge_locked(now)
+                self._m_queue_depth.set(len(self._queue))
+                self._m_inflight.set(sum(len(r.inflight)
+                                         for r in self._replicas))
+
+    def _check_deadlines_locked(self, now: float) -> None:
+        for req in list(self._live.values()):
+            if req.done or now <= req.deadline:
+                continue
+            self._m_deadline.inc()
+            trace.anomaly("router_deadline", request=req.id,
+                          deadline_s=req.deadline_s,
+                          delivered=len(req.delivered),
+                          redispatches=req.redispatches)
+            self._resolve_locked(
+                req, exc=DeadlineExceeded(
+                    req.id, req.deadline_s,
+                    detail=f"{len(req.delivered)} tokens delivered, "
+                           f"{req.redispatches} re-dispatches"))
+
+    def _maybe_shed_locked(self, req: _Request, now: float) -> None:
+        """A queued request no replica can take right now: if every
+        candidate is LIVE and has shed it (or is marked saturated),
+        propagate Backpressure — waiting would be a retry storm, not a
+        queue.  A candidate that is merely dead/partitioned keeps the
+        request queued: recovery or the deadline resolves it."""
+        candidates = [r for r in self._replicas if not r.gave_up]
+        if not candidates:
+            retry = max(0.5, self.respawn_backoff_s)
+        elif all(r.healthy and (r.id in req.bp_replicas
+                                or r.saturated_until > now)
+                 for r in candidates):
+            retry = max(0.05, max(r.saturated_until for r in candidates)
+                        - now) + self._ewma_latency
+        else:
+            return
+        self._m_bp_relayed.inc()
+        trace.anomaly("router_shed", reason="all_replicas_saturated",
+                      request=req.id, retry_after=retry)
+        self._resolve_locked(req, exc=Backpressure(retry))
+
+    def _dispatch_locked(self, req: _Request, rep: _Replica) -> None:
+        req.attempt += 1
+        wire_id = f"{req.id}.{req.attempt}"
+        req.active[wire_id] = rep.id
+        rep.inflight[wire_id] = req
+        req.last_dispatch = time.monotonic()
+        seq = self._dispatch_seq
+        self._dispatch_seq += 1
+        self._m_dispatch.inc()
+        msg = {"op": "submit", "id": wire_id,
+               "prompt": [int(t) for t in req.prompt],
+               "max_new_tokens": req.max_new_tokens,
+               "temperature": req.temperature, "eos_id": req.eos_id}
+        try:
+            send_msg(rep.wfile, rep.wlock, msg)
+        except (OSError, ValueError, AttributeError):
+            self._replica_down_locked(rep, "send_failed")
+            return
+        # prefix ownership: this replica's registry will hold these
+        # pages once the prefill completes — route siblings here
+        for digest in req.digests:
+            self._prefix_owner.pop(digest, None)   # re-insert at tail
+            self._prefix_owner[digest] = rep.id
+        while len(self._prefix_owner) > self._prefix_owner_cap:
+            self._prefix_owner.pop(next(iter(self._prefix_owner)))
+        # chaos replica_kill@req:N — fire AFTER the dispatch so the
+        # killed replica holds in-flight work (the case under test)
+        target = chaos.replica_kill(seq, rep.id)
+        if target is not None:
+            self._kill_replica(target)
+
+    def _maybe_hedge_locked(self, now: float) -> None:
+        for req in self._live.values():
+            if (req.done or not req.active or len(req.active) != 1
+                    or now - max(req.last_dispatch,
+                                 req.last_progress) < self.hedge_s):
+                continue
+            current = next(iter(req.active.values()))
+            eligible = [r for r in self._eligible_locked(req, now)
+                        if r.id != current]
+            if not eligible:
+                continue
+            rep = min(eligible, key=lambda r: (len(r.inflight), r.id))
+            self._m_hedge.inc()
+            trace.event("router_hedge", request=req.id,
+                        slow_replica=current, hedge_replica=rep.id)
+            self._dispatch_locked(req, rep)
+
+    def kill_replica(self, replica_id: int) -> None:
+        """SIGKILL a replica (chaos drills, the bench's kill-under-load
+        scenario).  The death is then DETECTED like any other — probe/
+        conn-EOF/proc-poll — so the full failover + respawn machinery
+        runs; nothing is short-circuited."""
+        self._kill_replica(int(replica_id))
+
+    def _kill_replica(self, target: int) -> None:
+        rep = self._replicas[target]
+        if rep.proc is not None:
+            rep.proc.kill()
+        elif self._kill_hook is not None:
+            self._kill_hook(target)
+        else:
+            log.error("router: chaos wants replica %d killed but the "
+                      "router neither owns its process nor has a "
+                      "kill_hook", target)
+
+    # -- replica message handling --------------------------------------
+    def _on_msg(self, rep: _Replica, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "stats":
+            tag = msg.get("tag", "")
+            ev = self._stats_events.pop((rep.id, tag), None)
+            if ev is not None:
+                # only a live waiter stores the snapshot (and pops it
+                # on read): an operator polling stats every few
+                # seconds must not grow this dict for the router's
+                # lifetime
+                rep.last_stats[tag] = msg
+                ev.set()
+            return
+        with self._mu:
+            wire_id = msg.get("id")
+            req = rep.inflight.get(wire_id)
+            if req is None or req.done:
+                self._m_stale.inc()
+                return
+            if op == "token":
+                i = int(msg["i"])
+                tok = int(msg["token"])
+                if i < len(req.delivered):
+                    # re-dispatched attempt replaying delivered ground:
+                    # verify, don't re-emit (greedy decode makes this an
+                    # equality by construction)
+                    if req.delivered[i] != tok and not req.diverged:
+                        req.diverged = True
+                        self._m_diverged.inc()
+                        trace.anomaly("redispatch_divergence",
+                                      request=req.id, index=i,
+                                      expected=req.delivered[i], got=tok)
+                elif i == len(req.delivered):
+                    req.delivered.append(tok)
+                    req.last_progress = time.monotonic()
+                    req.handle._emit(tok)
+                else:
+                    self._m_stale.inc()
+            elif op == "done":
+                rep.inflight.pop(wire_id, None)
+                req.active.pop(wire_id, None)
+                if msg.get("cancelled"):
+                    # the replica cancelled it (unclean shutdown path):
+                    # that is a failover, not an answer
+                    self._requeue_locked(req, reason="cancelled")
+                    return
+                tokens = [int(t) for t in msg["tokens"]]
+                for i in range(len(req.delivered), len(tokens)):
+                    req.handle._emit(tokens[i])
+                if (req.delivered != tokens[:len(req.delivered)]
+                        and not req.diverged):
+                    req.diverged = True
+                    self._m_diverged.inc()
+                    trace.anomaly("redispatch_divergence", request=req.id)
+                rep.completed += 1
+                finish = time.time()
+                latency = finish - req.submit_time
+                self._ewma_latency = (0.8 * self._ewma_latency
+                                      + 0.2 * latency)
+                self._m_completed.inc()
+                self._m_latency.observe(latency)
+                self._resolve_locked(req, result=RouterResult(
+                    request_id=req.id, tokens=tokens,
+                    prompt_len=int(req.prompt.size), latency_s=latency,
+                    replica=rep.id, redispatches=req.redispatches,
+                    diverged=req.diverged, submit_time=req.submit_time,
+                    finish_time=finish))
+            elif op == "backpressure":
+                rep.inflight.pop(wire_id, None)
+                req.active.pop(wire_id, None)
+                retry = float(msg.get("retry_after", 0.5))
+                rep.saturated_until = time.monotonic() + retry
+                req.bp_replicas.add(rep.id)
+                self._requeue_locked(req, reason="backpressure",
+                                     backoff=False)
+            elif op == "error":
+                rep.inflight.pop(wire_id, None)
+                self._resolve_locked(
+                    req, exc=RuntimeError(
+                        f"replica {rep.id} rejected request {req.id}: "
+                        f"{msg.get('error')}"))
+
+    def _requeue_locked(self, req: _Request, reason: str,
+                        backoff: bool = True) -> None:
+        if req.done or req.active:
+            return   # a hedged twin is still running it
+        if backoff:
+            req.redispatches += 1
+            self._m_failover.inc()
+            req.next_try = time.monotonic() + min(
+                self.retry_backoff_s * (2.0 ** (req.redispatches - 1)),
+                self.max_retry_backoff_s)
+        else:
+            req.next_try = 0.0
+        if req not in self._queue:
+            self._queue.append(req)
+        self._mu.notify_all()
+
+    def _resolve_locked(self, req: _Request, result=None,
+                        exc=None) -> None:
+        if req.done:
+            return
+        req.done = True
+        self._live.pop(req.id, None)
+        if req in self._queue:
+            self._queue.remove(req)
+        for wid, rid in list(req.active.items()):
+            self._replicas[rid].inflight.pop(wid, None)
+        req.active.clear()
+        self._outstanding -= 1
+        if exc is not None:
+            req.handle._fail(exc)
+        else:
+            req.handle._deliver(result)
+        self._mu.notify_all()
+
+    # -- health / failover / respawn -----------------------------------
+    def _connect_locked(self, rep: _Replica) -> bool:
+        ann = read_announce(self.rendezvous_dir, rep.id)
+        if ann is None:
+            return False
+        if rep.proc is not None and rep.proc.poll() is None \
+                and ann.get("pid") != rep.proc.pid:
+            return False   # stale announce from the previous generation
+        try:
+            conn = socket.create_connection(
+                ("127.0.0.1", int(ann["port"])), timeout=2.0)
+            # the connect timeout must NOT linger as the socket's i/o
+            # timeout: an idle tier has no wire traffic, and a reader
+            # whose blocking read times out after 2 quiet seconds reads
+            # as a dead connection — a reconnect flap every idle gap
+            conn.settimeout(None)
+            # …but SENDS must stay bounded: dispatch writes under the
+            # router lock, and a wedged-but-alive replica that stops
+            # draining its socket would otherwise block sendall()
+            # forever with _mu held — freezing admission, deadlines,
+            # and the prober (the component built to survive wedged
+            # replicas wedged by one).  SO_SNDTIMEO bounds send only;
+            # the reader's blocking recv is untouched.
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                            struct.pack("ll", 5, 0))
+        except OSError:
+            return False
+        self._close_conn(rep)
+        rep.conn = conn
+        rep.wfile = conn.makefile("wb")
+        rep.port = int(ann["port"])
+        rep.announced_pid = ann.get("pid")
+        # reader threads are daemons that exit with their connection —
+        # NOT retained (a long-lived router reconnects on every heal/
+        # respawn, and a list of dead Thread objects is a slow leak)
+        threading.Thread(target=self._reader, args=(rep, conn),
+                         daemon=True, name=f"router-read{rep.id}").start()
+        return True
+
+    def _close_conn(self, rep: _Replica) -> None:
+        conn, rep.conn, rep.wfile = rep.conn, None, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _reader(self, rep: _Replica, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        try:
+            for line in rfile:
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                self._on_msg(rep, msg)
+        except (OSError, ValueError):
+            pass
+        finally:
+            with self._mu:
+                if not self._stopping and rep.conn is conn:
+                    self._replica_down_locked(rep, "conn_lost")
+
+    def _replica_down_locked(self, rep: _Replica, reason: str) -> None:
+        """The router's verdict that a replica is gone (heartbeat
+        silence, dead socket, process exit).  Close the transport,
+        re-dispatch everything it held, and say so — loudly when it
+        was healthy a moment ago."""
+        was_healthy = rep.healthy
+        rep.healthy = False
+        self._m_health[rep.id].set(0)
+        self._close_conn(rep)
+        stranded = list(rep.inflight.values())
+        rep.inflight.clear()
+        for req in stranded:
+            for wid in [w for w, rid in req.active.items()
+                        if rid == rep.id]:
+                req.active.pop(wid, None)
+            self._requeue_locked(req, reason=reason)
+        if was_healthy:
+            log.error("router: replica %d lost (%s) — %d in-flight "
+                      "request(s) re-dispatched", rep.id, reason,
+                      len(stranded))
+            trace.anomaly("replica_lost", replica=rep.id, reason=reason,
+                          redispatched=len(stranded))
+
+    def _probe_loop(self) -> None:
+        while not self._stopping:
+            time.sleep(self.probe_interval_s)
+            if self._stopping:
+                return
+            traffic = self._dispatch_seq > 0
+            now = time.monotonic()
+            with self._mu:
+                for rep in self._replicas:
+                    if rep.gave_up:
+                        continue
+                    self._probe_one_locked(rep, now, traffic)
+
+    def _probe_one_locked(self, rep: _Replica, now: float,
+                          traffic: bool) -> None:
+        # process supervision (proc mode): exits schedule a respawn
+        # under the sliding-window budget
+        if (rep.proc is not None and rep.proc.poll() is not None
+                and rep.respawn_at is None):
+            code = rep.proc.returncode
+            self._replica_down_locked(rep, f"exit:{code}")
+            while (rep.respawn_times and now - rep.respawn_times[0]
+                    > self.respawn_window_s):
+                rep.respawn_times.popleft()
+            if len(rep.respawn_times) >= self.max_respawns:
+                rep.gave_up = True
+                log.error("router: replica %d gave up (%d respawns in "
+                          "window)", rep.id, len(rep.respawn_times))
+                trace.anomaly("replica_give_up", replica=rep.id,
+                              respawns=len(rep.respawn_times),
+                              window_s=self.respawn_window_s)
+                return
+            rep.respawn_times.append(now)
+            backoff = (self.respawn_backoff_s
+                       * (2.0 ** (len(rep.respawn_times) - 1)))
+            rep.respawn_at = now + backoff
+            trace.event("replica_respawn", replica=rep.id, code=code,
+                        backoff_s=backoff,
+                        respawns=len(rep.respawn_times),
+                        budget=self.max_respawns)
+        if rep.respawn_at is not None and now >= rep.respawn_at:
+            rep.respawn_at = None
+            rep.generation += 1
+            self._m_respawns.inc()
+            rep.proc = self._spawn(rep.id, rep.generation)
+            rep.last_beat_mono = now   # fresh startup grace
+            log.warning("router: respawned replica %d (generation %d)",
+                        rep.id, rep.generation)
+
+        # chaos net_partition: drop this probe — the router sees
+        # SILENCE, exactly what a partition or stalled host looks like
+        partitioned = chaos.net_partition(rep.id, traffic)
+        if not partitioned:
+            try:
+                mt = os.stat(heartbeat_path(self.rendezvous_dir,
+                                            rep.id)).st_mtime
+            except OSError:
+                mt = rep.hb_mtime
+            if mt != rep.hb_mtime:
+                rep.hb_mtime = mt
+                hb = read_heartbeat(heartbeat_path(self.rendezvous_dir,
+                                                   rep.id))
+                if hb is not None and hb.get("ts") != rep.last_beat_ts:
+                    rep.last_beat_ts = hb.get("ts")
+                    rep.last_beat_mono = now
+
+        fresh = (now - rep.last_beat_mono) <= self.health_timeout_s
+        if rep.healthy:
+            if partitioned or not fresh:
+                self._replica_down_locked(
+                    rep, "net_partition_or_stall" if partitioned
+                    else "heartbeat_timeout")
+        elif fresh and not partitioned:
+            # beats are fresh again: (re)connect and fold it back in
+            if rep.conn is None and not self._connect_locked(rep):
+                return
+            rep.healthy = True
+            self._m_health[rep.id].set(1)
+            trace.event("replica_registered", replica=rep.id,
+                        port=rep.port, pid=rep.announced_pid)
+            log.info("router: replica %d registered (port %s, pid %s)",
+                     rep.id, rep.port, rep.announced_pid)
+            self._mu.notify_all()
+
+    # -- introspection -------------------------------------------------
+    def replica_healthy(self, replica_id: int) -> bool:
+        with self._mu:
+            return self._replicas[replica_id].healthy
+
+    def replica_completed(self, replica_id: int) -> int:
+        """Requests this replica finished (router-side count — survives
+        replica respawns, unlike the replica's own counter)."""
+        with self._mu:
+            return self._replicas[replica_id].completed
+
+    def replica_stats(self, replica_id: int,
+                      timeout: float = 5.0) -> Optional[dict]:
+        """Round-trip a stats snapshot from a replica's engine (the
+        bench reads prefix-registry hit counters through this)."""
+        rep = self._replicas[replica_id]
+        tag = f"s{time.monotonic_ns()}"
+        ev = threading.Event()
+        with self._mu:
+            if rep.wfile is None:
+                return None
+            self._stats_events[(rep.id, tag)] = ev
+            try:
+                send_msg(rep.wfile, rep.wlock,
+                         {"op": "stats", "tag": tag})
+            except (OSError, ValueError):
+                self._stats_events.pop((rep.id, tag), None)
+                return None
+        if not ev.wait(timeout):
+            self._stats_events.pop((rep.id, tag), None)
+            return None
+        return rep.last_stats.pop(tag, None)
+
+
+def replica_spawner(cmd: List[str], rendezvous_dir: str,
+                    log_dir: Optional[str] = None,
+                    env_extra: Optional[dict] = None,
+                    cwd: Optional[str] = None) -> Callable:
+    """Standard spawn callable for :class:`Router`: runs ``cmd`` with
+    the replica-tier environment contract — DTF_PROCESS_ID = replica
+    id (announce/heartbeat/trace rank identity), DTF_HEARTBEAT_DIR =
+    the rendezvous dir, DTF_RESTART_GENERATION = respawn generation
+    (the PR-4/PR-5 restart-tagging contract) — logging each replica to
+    ``replica{K}.log`` (``.retry{G}`` suffixed on respawn, keeping the
+    first failure's log like the launcher does)."""
+    rendezvous_dir = os.path.abspath(rendezvous_dir)
+    log_dir = os.path.abspath(log_dir or rendezvous_dir)
+    # the replica must import dtf_tpu no matter where the ROUTER was
+    # launched from — or what ``cwd`` the caller picked: the repo root
+    # goes on PYTHONPATH unconditionally (a spawn that only imports
+    # from one directory is a crash-loop that eats the whole respawn
+    # budget before anyone reads replica0.log)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cwd = os.path.abspath(cwd) if cwd else repo_root
+
+    def spawn(replica_id: int, generation: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["DTF_PROCESS_ID"] = str(replica_id)
+        env["DTF_HEARTBEAT_DIR"] = rendezvous_dir
+        env["DTF_RESTART_GENERATION"] = str(generation)
+        env["PYTHONPATH"] = (repo_root + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        env.update(env_extra or {})
+        os.makedirs(log_dir, exist_ok=True)
+        suffix = f".retry{generation}" if generation else ""
+        logf = open(os.path.join(
+            log_dir, f"replica{replica_id}{suffix}.log"), "wb")
+        try:
+            return subprocess.Popen(cmd + ["--replica_id",
+                                           str(replica_id)],
+                                    env=env, cwd=cwd, stdout=logf,
+                                    stderr=subprocess.STDOUT)
+        finally:
+            logf.close()
+
+    return spawn

@@ -47,6 +47,25 @@ def test_parse_spec_grammar():
     assert str(specs[1]) == "sigterm@rank1:step:80"
 
 
+def test_parse_spec_distributed_kinds():
+    """The serving-tier kinds: replica selectors, the bare-value
+    shorthand the grammar docs promise (net_partition@replica1:6),
+    and round-tripping through str()."""
+    specs = chaos.parse_spec(
+        "replica_kill@req:5, replica_kill@replica0:req:3,"
+        "net_partition@replica1:6, slow_replica@replica0:2.5,"
+        "net_partition@replica2:ticks:4")
+    got = [(s.kind, s.replica, s.value) for s in specs]
+    assert got == [("replica_kill", None, 5), ("replica_kill", 0, 3),
+                   ("net_partition", 1, 6), ("slow_replica", 0, 2.5),
+                   ("net_partition", 2, 4)]
+    # canonical str() re-parses to the same spec
+    for s in specs:
+        (again,) = chaos.parse_spec(str(s))
+        assert (again.kind, again.replica, again.value) == (
+            s.kind, s.replica, s.value)
+
+
 @pytest.mark.parametrize("bad", [
     "explode@step:3",           # unknown kind
     "crash@version:3",          # wrong point for the kind
@@ -55,6 +74,11 @@ def test_parse_spec_grammar():
     "ckpt_truncate@step:3",     # kind takes 'latest'
     "crash@rankX:step:3",       # bad rank selector
     "crash@step:-1",            # negative value
+    "net_partition@4",          # partition needs a replica target
+    "slow_replica@replica0:1.0",  # factor must be > 1
+    "net_partition@replica1:0",   # >= 1 probe tick
+    "replica_kill@step:4",      # wrong point for the kind
+    "net_partition@replicaX:4",  # bad replica selector
 ])
 def test_parse_spec_rejects(bad):
     with pytest.raises(ValueError):

@@ -338,6 +338,51 @@ def test_trace_main_merge_time_ordered_cross_rank(tmp_path, capsys):
     assert all("ts" in r for r in lines)
 
 
+def test_trace_main_merge_orders_router_and_replica_streams(tmp_path,
+                                                            capsys):
+    """The serving router writes a NAMED stream (trace_router.jsonl,
+    records tagged rank="router") next to its replicas' per-rank
+    files; --merge interleaves the tiers into one timeline — the view
+    that answers "what did the router see when replica 1 died?"."""
+    for rank in (0, 1):
+        t = trace.configure(str(tmp_path), rank=rank)
+        trace.event("serve_submit", step=rank)
+        t.flush()
+        trace.disable()
+        time.sleep(0.002)
+    t = trace.configure(str(tmp_path), stream="router")
+    trace.event("replica_registered", replica=0)
+    trace.anomaly("replica_lost", replica=1, reason="heartbeat_timeout")
+    t.flush()
+    trace.disable()
+    assert os.path.exists(str(tmp_path / "trace_router.jsonl"))
+    # the router anomaly fails --check like any rank's would
+    assert trace_main([str(tmp_path), "--merge", "--check"]) == 1
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert {r["rank"] for r in lines} == {0, 1, "router"}
+    ts = [float(r["ts"]) for r in lines]
+    assert ts == sorted(ts)
+    # allowed named-stream anomalies pass, exactly like rank anomalies
+    assert trace_main([str(tmp_path), "--merge", "--check",
+                       "--allow", "replica_lost"]) == 0
+
+
+def test_trace_main_allow_warns_on_unknown_kind(tmp_path, capsys):
+    """A typo'd --allow silently tolerating nothing is the bug an
+    expected-anomaly list invites — unknown kinds warn loudly (but do
+    not fail: new subsystems may emit kinds the registry hasn't
+    learned)."""
+    _write_trace(tmp_path, with_anomaly=False)
+    assert trace_main([str(tmp_path), "--check",
+                       "--allow", "replica_lsot"]) == 0
+    assert "replica_lsot" in capsys.readouterr().err
+    _write_trace(tmp_path, with_anomaly=False)
+    assert trace_main([str(tmp_path), "--check",
+                       "--allow", "replica_lost"]) == 0
+    assert "not a known anomaly kind" not in capsys.readouterr().err
+
+
 def test_trace_main_merge_composes_with_check(tmp_path, capsys):
     _write_trace(tmp_path, with_anomaly=True)
     assert trace_main([str(tmp_path), "--merge", "--check"]) == 1

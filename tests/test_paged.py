@@ -259,6 +259,47 @@ def test_long_prompt_prefills_in_chunks_while_decoding(model_and_params):
         eng.stop(drain=False)
 
 
+def test_begin_drain_racing_inflight_prefill_chunk(model_and_params):
+    """begin_drain() landing BETWEEN a request's prefill chunks (the
+    SIGTERM-mid-prefill race): the drain must finish that request —
+    remaining chunks run, decode completes, tokens stream — not strand
+    its pages or drop it, while NEW submits shed.  Pinned against the
+    no-drain oracle and a fully-reclaimed pool."""
+    import time as _time
+
+    from dtf_tpu.serve import Backpressure
+    model, params = model_and_params
+    # sharing off so full reclamation is exactly used_pages == 0 (the
+    # owning registry would intentionally keep prompt pages alive)
+    eng = paged_engine(model, params, max_batch=2, prefix_sharing=False)
+    try:
+        rng = np.random.default_rng(23)
+        long_p = rng.integers(0, VOCAB, (SEQ - 4,)).astype(np.int32)
+        h = eng.submit(long_p, max_new_tokens=4)   # 28 tokens = 4 chunks
+        streamed = []
+        # the race: drain the moment the FIRST chunk has run, while
+        # chunks 2-4 are still pending in the slot's chunk plan
+        deadline = _time.time() + 120
+        while (eng.metrics.get("serve_prefill_chunks_total").value < 1
+               and _time.time() < deadline):
+            _time.sleep(0.001)
+        assert eng.metrics.get("serve_prefill_chunks_total").value >= 1
+        eng.begin_drain()
+        with pytest.raises(Backpressure):
+            eng.submit(np.array([1], np.int32), max_new_tokens=1)
+        streamed = list(h.stream(timeout=300))
+        r = h.result(timeout=300)
+        assert not r.cancelled
+        assert r.tokens == _oracle(model, params, long_p, 4)
+        assert streamed == r.tokens, "drain dropped streamed tokens"
+        assert eng.metrics.get("serve_prefill_chunks_total").value >= 4
+        eng.stop(drain=True)
+        assert eng.pool.used_pages == 0, (
+            f"drain stranded {eng.pool.used_pages} pages")
+    finally:
+        eng.stop(drain=False)
+
+
 def test_unchunked_and_chunked_prefill_agree(model_and_params):
     """prefill_chunk=0 (whole-prompt single chunk) and chunked prefill
     produce identical greedy output — chunking is pure scheduling."""

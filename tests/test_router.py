@@ -1,0 +1,498 @@
+"""Serving replica tier: router placement, deadlines, retry/failover,
+backpressure propagation, and the distributed chaos kinds.
+
+All tier-1: the replicas here are REAL ReplicaServer instances (the
+full wire protocol) over a deterministic jax-free fake engine, run
+in-process — so replica death is a server teardown, not a subprocess
+SIGKILL, and the whole suite runs in seconds.  The real-subprocess
+path (cli/replica_main.py spawned and respawned by the router, engine
+heartbeats from the engine loop) is pinned by tools/router_smoke.py
+(ci_check stage 9) and its slow-marked wrapper below.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dtf_tpu import chaos
+from dtf_tpu.obs import trace
+from dtf_tpu.obs.watchdog import Heartbeat, heartbeat_path
+from dtf_tpu.serve.engine import Backpressure
+from dtf_tpu.serve.replica import ReplicaServer, read_announce
+from dtf_tpu.serve.router import (PLACEMENTS, DeadlineExceeded, Router)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos_leak():
+    yield
+    chaos.disable()
+
+
+def oracle(prompt, n):
+    """The fake engine's deterministic decode: token i of a prompt is a
+    pure function of (prompt, i) — replica-interchangeable, like greedy
+    decode over identical params."""
+    s = int(np.asarray(prompt, np.int64).sum()) % 97
+    return [(s * 31 + i * 7) % 97 for i in range(n)]
+
+
+class _FakeHandle:
+    def __init__(self):
+        self._ev = threading.Event()
+        self._res = None
+
+    def result(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("fake engine request not finished")
+        return self._res
+
+
+class _FakeResult:
+    def __init__(self, tokens, plen):
+        self.tokens = tokens
+        self.cancelled = False
+        self.prompt_len = plen
+        self.latency_s = 0.01
+
+
+class FakeEngine:
+    """ServeEngine's wire-facing surface (submit/begin_drain/
+    outstanding) over the oracle, with a per-token delay so kills can
+    land mid-request."""
+
+    def __init__(self, tok_delay=0.004, queue_limit=64):
+        self.tok_delay = tok_delay
+        self.queue_limit = queue_limit
+        self._n = 0
+        self.submitted = 0
+        self._mu = threading.Lock()
+        self.draining = False
+        self.dead = False
+
+    @property
+    def outstanding(self):
+        return self._n
+
+    def begin_drain(self):
+        self.draining = True
+
+    def submit(self, prompt, max_new_tokens=32, temperature=0.0,
+               eos_id=None, on_token=None):
+        with self._mu:
+            if self.draining or self._n >= self.queue_limit:
+                raise Backpressure(0.3)
+            self._n += 1
+            self.submitted += 1
+        handle = _FakeHandle()
+        toks = oracle(prompt, max_new_tokens)
+
+        def run():
+            for t in toks:
+                if self.dead:
+                    return      # a killed replica never answers
+                time.sleep(self.tok_delay)
+                if on_token:
+                    on_token(t)
+            handle._res = _FakeResult(toks, len(prompt))
+            handle._ev.set()
+            with self._mu:
+                self._n -= 1
+
+        threading.Thread(target=run, daemon=True).start()
+        return handle
+
+
+class FakeReplica:
+    """ReplicaServer + FakeEngine + a heartbeat thread — everything a
+    replica process provides, minus the process."""
+
+    def __init__(self, rid, rdir, **engine_kw):
+        self.rid, self.rdir, self.engine_kw = rid, rdir, engine_kw
+        self.engine = None
+        self.server = None
+        self._hb_stop = None
+
+    def start(self):
+        self.engine = FakeEngine(**self.engine_kw)
+        self.server = ReplicaServer(self.engine, self.rid,
+                                    self.rdir).start()
+        self._hb_stop = threading.Event()
+        hb = Heartbeat(heartbeat_path(self.rdir, self.rid),
+                       interval_s=0.04)
+        stop, eng = self._hb_stop, self.engine
+
+        def beat():
+            while not stop.wait(0.04):
+                hb.beat(step=eng.submitted)
+
+        threading.Thread(target=beat, daemon=True).start()
+        return self
+
+    def kill(self):
+        """Abrupt death: tokens stop, heartbeat stops, socket drops."""
+        self.engine.dead = True
+        self._hb_stop.set()
+        self.server.stop()
+
+
+def make_tier(tmp_path, n=2, router_kw=None, engine_kw=None):
+    rdir = str(tmp_path / "rdv")
+    os.makedirs(rdir, exist_ok=True)
+    reps = [FakeReplica(i, rdir, **(engine_kw or {})).start()
+            for i in range(n)]
+    kw = dict(probe_interval_s=0.05, health_timeout_s=0.3,
+              deadline_s=30.0, replica_inflight=32, page_size=8,
+              kill_hook=lambda rid: reps[rid].kill())
+    kw.update(router_kw or {})
+    router = Router(n, rdir, **kw)
+    router.start(wait_s=10)
+    return router, reps
+
+
+def stop_tier(router, reps):
+    router.stop(drain=False)
+    for r in reps:
+        try:
+            r.kill()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# basics: routing, exactness, placement
+# ---------------------------------------------------------------------------
+
+def test_router_roundtrip_token_exact_and_spread(tmp_path):
+    """A burst of varied prompts completes token-exact vs the oracle,
+    and least-loaded placement uses BOTH replicas."""
+    router, reps = make_tier(tmp_path, 2,
+                             router_kw=dict(placement="least_loaded"))
+    try:
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 97, (int(rng.integers(3, 30)),))
+                   .astype(np.int32) for _ in range(10)]
+        handles = [router.submit(p, max_new_tokens=6) for p in prompts]
+        results = [h.result(timeout=20) for h in handles]
+        for r, p in zip(results, prompts):
+            assert r.tokens == oracle(p, 6)
+            assert r.redispatches == 0 and not r.diverged
+        assert all(reps[i].engine.submitted > 0 for i in range(2)), (
+            "least-loaded placement left a replica idle under a burst")
+        assert router.metrics.get("router_completed_total").value == 10
+    finally:
+        stop_tier(router, reps)
+
+
+def test_router_prefix_affinity_routes_shared_prompts_together(tmp_path):
+    """Two groups sharing distinct system prompts: once each group's
+    first request lands, prefix-affine placement sends every sibling
+    to the SAME replica (warm-registry routing), and the affinity-hit
+    counter proves it was the digest chain, not luck."""
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.01))
+    try:
+        ps = router.page_size
+        rng = np.random.default_rng(1)
+        groups = [rng.integers(0, 97, (2 * ps,)).astype(np.int32)
+                  for _ in range(2)]
+        # concurrent warmers: group A occupies one replica so group B's
+        # least-loaded fallback picks the other — ownership splits
+        warm = [router.submit(g, max_new_tokens=4) for g in groups]
+        for h in warm:
+            h.result(timeout=10)
+        owners = []
+        for g in groups:
+            counts0 = [r.engine.submitted for r in reps]
+            hs = [router.submit(
+                np.concatenate([g, rng.integers(0, 97, (3,))
+                                .astype(np.int32)]), max_new_tokens=4)
+                for _ in range(4)]
+            for h in hs:
+                h.result(timeout=10)
+            deltas = [r.engine.submitted - c
+                      for r, c in zip(reps, counts0)]
+            assert sorted(deltas) == [0, 4], (
+                f"group traffic split {deltas} across replicas — "
+                f"prefix affinity should pin it to the owner")
+            owners.append(deltas.index(4))
+        assert router.metrics.get("router_affinity_hits_total").value >= 8
+    finally:
+        stop_tier(router, reps)
+
+
+def test_placement_literal_parity_with_config():
+    """config/flags.py validates router_placement against a LITERAL
+    copy of PLACEMENTS (Config must not import the serve stack) —
+    keep them identical."""
+    assert PLACEMENTS == ("affinity", "least_loaded", "random")
+
+
+# ---------------------------------------------------------------------------
+# degrade, never hang
+# ---------------------------------------------------------------------------
+
+def test_router_admission_bound_sheds_immediately(tmp_path):
+    """Outstanding at the admission limit: the NEXT submit raises
+    Backpressure synchronously — shed at the door, not queued into a
+    hang."""
+    router, reps = make_tier(
+        tmp_path, 1, router_kw=dict(admission_limit=2),
+        engine_kw=dict(tok_delay=0.2))
+    try:
+        p = np.arange(4, dtype=np.int32)
+        h1 = router.submit(p, max_new_tokens=50)
+        h2 = router.submit(p + 1, max_new_tokens=50)
+        t0 = time.monotonic()
+        with pytest.raises(Backpressure) as ei:
+            router.submit(p + 2, max_new_tokens=4)
+        assert time.monotonic() - t0 < 0.5
+        assert ei.value.retry_after > 0
+        assert router.metrics.get("router_shed_total").value == 1
+        del h1, h2
+    finally:
+        stop_tier(router, reps)
+
+
+def test_router_backpressure_propagates_not_retried(tmp_path):
+    """Every live replica sheds the request: the Backpressure reaches
+    the CLIENT (bounded time), instead of the router retry-storming
+    the saturated tier."""
+    router, reps = make_tier(tmp_path, 2)
+    try:
+        for r in reps:
+            r.engine.draining = True   # every submit sheds retry_after
+        t0 = time.monotonic()
+        h = router.submit(np.arange(6, dtype=np.int32), max_new_tokens=4)
+        with pytest.raises(Backpressure) as ei:
+            h.result(timeout=5)
+        assert time.monotonic() - t0 < 2.0, (
+            "all-replicas-saturated Backpressure took unbounded time")
+        assert ei.value.retry_after > 0
+        assert router.metrics.get(
+            "router_backpressure_relayed_total").value == 1
+        # and the stream view raises too — a shed is never a short answer
+        with pytest.raises(Backpressure):
+            list(h.stream(timeout=1))
+    finally:
+        stop_tier(router, reps)
+
+
+def test_router_deadline_exceeded_resolves_in_time(tmp_path):
+    """A replica too slow for the deadline: the request resolves with
+    DeadlineExceeded AT the deadline (not at the slow replica's
+    pace) — every accepted request resolves within its deadline."""
+    router, reps = make_tier(tmp_path, 1,
+                             engine_kw=dict(tok_delay=0.5))
+    try:
+        t0 = time.monotonic()
+        h = router.submit(np.arange(5, dtype=np.int32),
+                          max_new_tokens=50, deadline_s=0.4)
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=5)
+        assert time.monotonic() - t0 < 1.5
+        assert router.metrics.get(
+            "router_deadline_exceeded_total").value == 1
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# failover: death, re-dispatch exactness, re-registration
+# ---------------------------------------------------------------------------
+
+def test_router_failover_token_exact_stream_dedupes(tmp_path):
+    """Kill a replica mid-decode: its in-flight requests re-dispatch
+    to the sibling and finish with the EXACT oracle tokens — and a
+    streaming consumer sees every token exactly once (the re-
+    dispatched attempt's replay is verified, not re-emitted)."""
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.02))
+    try:
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, 97, (6,)).astype(np.int32)
+                   for _ in range(4)]
+        handles = [router.submit(p, max_new_tokens=30) for p in prompts]
+        streams = [[] for _ in handles]
+        threads = [threading.Thread(
+            target=lambda h=h, out=out: out.extend(h.stream(timeout=30)),
+            daemon=True) for h, out in zip(handles, streams)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)            # several tokens in on both replicas
+        reps[0].kill()
+        results = [h.result(timeout=30) for h in handles]
+        for t in threads:
+            t.join(timeout=30)
+        assert router.metrics.get("router_failover_total").value >= 1
+        redispatched = 0
+        for r, p, s in zip(results, prompts, streams):
+            want = oracle(p, 30)
+            assert r.tokens == want
+            assert s == want, "stream must dedupe the failover replay"
+            assert not r.diverged
+            redispatched += r.redispatches
+        assert redispatched >= 1, "the kill should have stranded work"
+    finally:
+        stop_tier(router, reps)
+
+
+def test_router_dead_replica_reregisters_and_serves(tmp_path):
+    """A replica that died and came back (new port, same announce
+    file) is folded back in by the prober and takes traffic again."""
+    router, reps = make_tier(tmp_path, 2)
+    try:
+        reps[0].kill()
+        t0 = time.monotonic()
+        while router.replica_healthy(0) and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        assert not router.replica_healthy(0)
+        old_port = read_announce(reps[0].rdir, 0)["port"]
+        reps[0] = FakeReplica(0, reps[0].rdir).start()
+        assert read_announce(reps[0].rdir, 0)["port"] != old_port
+        t0 = time.monotonic()
+        while not router.replica_healthy(0) and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        assert router.replica_healthy(0), "respawned replica never " \
+            "re-registered"
+        before = reps[0].engine.submitted
+        # least-loaded on an idle tier prefers the lowest id: replica 0
+        h = router.submit(np.arange(7, dtype=np.int32), max_new_tokens=4)
+        assert h.result(timeout=10).tokens == oracle(
+            np.arange(7, dtype=np.int32), 4)
+        assert reps[0].engine.submitted + reps[1].engine.submitted > 0
+    finally:
+        stop_tier(router, reps)
+
+
+def test_router_hedge_covers_a_stalled_replica(tmp_path):
+    """hedge_s: a dispatched request with no progress gets a second,
+    token-identical attempt on a sibling; first done wins."""
+    router, reps = make_tier(
+        tmp_path, 2, router_kw=dict(hedge_s=0.15,
+                                    placement="least_loaded"),
+        engine_kw=dict(tok_delay=0.004))
+    try:
+        reps[0].engine.tok_delay = 1.0   # replica 0 stalls, stays alive
+        p = np.arange(9, dtype=np.int32)
+        t0 = time.monotonic()
+        h = router.submit(p, max_new_tokens=8)
+        r = h.result(timeout=10)
+        assert r.tokens == oracle(p, 8)
+        assert time.monotonic() - t0 < 2.0, "hedge should beat the stall"
+        assert router.metrics.get("router_hedge_total").value == 1
+        assert r.replica == 1
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the distributed fault kinds
+# ---------------------------------------------------------------------------
+
+def test_chaos_replica_kill_mid_traffic_token_exact(tmp_path):
+    """replica_kill@req:N through the router's dispatch probe: the
+    target dies holding work, everything still completes token-exact,
+    zero lost requests."""
+    chaos.configure("replica_kill@req:2", rank=0)
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.02))
+    try:
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, 97, (5,)).astype(np.int32)
+                   for _ in range(6)]
+        handles = [router.submit(p, max_new_tokens=20) for p in prompts]
+        results = [h.result(timeout=30) for h in handles]
+        for r, p in zip(results, prompts):
+            assert r.tokens == oracle(p, 20)
+        assert router.metrics.get("router_failover_total").value >= 1
+        assert sum(r.engine.dead for r in reps) == 1
+    finally:
+        stop_tier(router, reps)
+
+
+def test_chaos_net_partition_timeouts_then_heals(tmp_path):
+    """net_partition@replica<K>:<ticks>: the router sees probe
+    SILENCE (not a clean exit), declares the replica lost, re-routes;
+    when the partition heals the replica re-registers — its process
+    never died — and serves again."""
+    # 12 ticks x 0.05s probe = 0.6s partition vs 0.3s health timeout
+    chaos.configure("net_partition@replica1:12", rank=0)
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.01))
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 97, (8,)).astype(np.int32)
+                   for _ in range(8)]
+        handles = [router.submit(p, max_new_tokens=12) for p in prompts]
+        # traffic starts -> partition starts -> replica 1 goes unhealthy
+        t0 = time.monotonic()
+        saw_down = False
+        while time.monotonic() - t0 < 3:
+            if not router.replica_healthy(1):
+                saw_down = True
+                break
+            time.sleep(0.02)
+        assert saw_down, "partitioned replica never declared lost"
+        results = [h.result(timeout=30) for h in handles]
+        for r, p in zip(results, prompts):
+            assert r.tokens == oracle(p, 12)
+        # partition heals -> re-register (the process never died)
+        t0 = time.monotonic()
+        while not router.replica_healthy(1) and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        assert router.replica_healthy(1), "replica did not re-register " \
+            "after the partition healed"
+        assert not reps[1].engine.dead
+        before = reps[1].engine.submitted
+        # FRESH prompts (no affinity owner): least-loaded fallback
+        # spreads the concurrent burst over both replicas again
+        hs = [router.submit(rng.integers(0, 97, (8,)).astype(np.int32),
+                            max_new_tokens=4) for _ in range(8)]
+        for h in hs:
+            h.result(timeout=10)
+        assert reps[1].engine.submitted > before, (
+            "healed replica took no traffic")
+    finally:
+        stop_tier(router, reps)
+
+
+def test_chaos_slow_replica_spec_reaches_engine(monkeypatch):
+    """slow_replica@replica<K>:<F> latches only in the process whose
+    rank == K, returns its factor, and records once."""
+    chaos.configure("slow_replica@replica1:3", rank=1)
+    assert chaos.slow_replica() == 3.0
+    assert chaos.slow_replica() == 3.0     # latched, not one-shot
+    chaos.configure("slow_replica@replica1:3", rank=0)
+    assert chaos.slow_replica() == 0.0     # wrong replica: untouched
+
+
+def test_router_replica_stats_roundtrip(tmp_path):
+    router, reps = make_tier(tmp_path, 2)
+    try:
+        router.generate(np.arange(4, dtype=np.int32), max_new_tokens=3)
+        stats = router.replica_stats(0, timeout=5)
+        assert stats is not None and stats["replica"] == 0
+        assert "outstanding" in stats
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# the real-subprocess matrix (the ci_check stage-9 contract)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_router_smoke_tool_end_to_end():
+    """tools/router_smoke.py: real replica subprocesses, kill +
+    partition + slow chaos arms, token-exactness and zero lost
+    requests, respawn re-registration, trace-merge timeline."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "router_smoke.py")],
+        capture_output=True, text=True, timeout=1500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, (
+        f"router smoke failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")

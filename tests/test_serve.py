@@ -5,6 +5,7 @@ batching engine's edge cases.
 All tier-1 (no `slow` marks): tiny models, CPU mesh.
 """
 
+import os
 import time
 
 import jax
@@ -174,6 +175,37 @@ def test_engine_single_oversized_request_rejected_loudly(engine):
     r = engine.submit(np.array([1], np.int32),
                       max_new_tokens=2).result(timeout=120)
     assert len(r.tokens) == 2
+
+
+def test_engine_heartbeat_from_engine_loop(model_and_params, tmp_path):
+    """Serve processes emit obs heartbeat files like train ranks do:
+    the ENGINE LOOP rewrites heartbeat_rank{N}.json (step = completed
+    count), so launch.py's hang watchdog — and the serving router's
+    health probe — cover serving.  Beating from the loop is the
+    contract: a deadlocked engine thread stops beating."""
+    from dtf_tpu.obs.watchdog import Heartbeat, heartbeat_path, \
+        read_heartbeat
+    model, params = model_and_params
+    path = heartbeat_path(str(tmp_path), 0)
+    eng = ServeEngine(model, params, max_batch=2, max_seq_len=SEQ,
+                      max_delay_s=0.0,
+                      heartbeat=Heartbeat(path, interval_s=0.01))
+    try:
+        assert read_heartbeat(path) is not None, \
+            "heartbeat file must exist before the first request"
+        eng.submit(np.array([1, 2], np.int32),
+                   max_new_tokens=2).result(timeout=120)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            hb = read_heartbeat(path)
+            if hb and hb.get("step") == 1:
+                break
+            time.sleep(0.02)
+        assert read_heartbeat(path)["step"] == 1, (
+            "engine loop never beat with the completed count")
+        assert read_heartbeat(path)["pid"] == os.getpid()
+    finally:
+        eng.stop(drain=False)
 
 
 def test_engine_sheds_under_backpressure(model_and_params):

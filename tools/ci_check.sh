@@ -53,9 +53,19 @@
 #      bars hold — prefix sharing fits >= 2x the concurrent sequences
 #      of the no-sharing pool at equal page budget, and the first
 #      STREAMED token lands before full retire.
+#   9. tools/router_smoke.py — the serving REPLICA-TIER contract
+#      (serve/router.py over real cli/replica_main.py subprocesses):
+#      with replica_kill / net_partition / slow_replica chaos injected
+#      mid-traffic, every accepted request completes TOKEN-EXACT vs an
+#      unfaulted baseline, zero requests are lost, the dead replica
+#      respawns (budgeted) and re-registers, the partitioned replica
+#      re-registers WITHOUT a respawn when the partition heals, and
+#      `trace_main --check --allow injected_fault --allow
+#      replica_lost` proves the chaos run contained the injected fault
+#      + the router's reaction and nothing else.
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-8 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-9 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -63,18 +73,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/8]: tier-1 test suite =="
+    echo "== ci_check [1/9]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/8]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/9]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/8]: marker audit (test-budget contract) =="
+echo "== ci_check [2/9]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/8]: traced smoke run =="
+echo "== ci_check [3/9]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -82,13 +92,13 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/8]: anomaly cleanliness =="
+echo "== ci_check [4/9]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
 
-echo "== ci_check [5/8]: chaos smoke (kill -> resume -> exactness) =="
+echo "== ci_check [5/9]: chaos smoke (kill -> resume -> exactness) =="
 python tools/chaos_smoke.py
 
-echo "== ci_check [6/8]: parallelism planner (check + calibration) =="
+echo "== ci_check [6/9]: parallelism planner (check + calibration) =="
 python bench_plan.py --out "$TRACE_DIR/PLAN_4x4.json" >/dev/null
 python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
     --dataset lm --use_synthetic_data --seq_len 64 --batch_size 8 \
@@ -102,10 +112,13 @@ python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
     --benchmark_log_dir "$TRACE_DIR/plan_bench"
 grep -q plan_step_time_ratio "$TRACE_DIR/plan_bench/metric.log"
 
-echo "== ci_check [7/8]: data-service smoke (sharded determinism + imagenet resume exactness) =="
+echo "== ci_check [7/9]: data-service smoke (sharded determinism + imagenet resume exactness) =="
 python tools/data_service_smoke.py
 
-echo "== ci_check [8/8]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
+echo "== ci_check [8/9]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
 python tools/serve_smoke.py
+
+echo "== ci_check [9/9]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
+python tools/router_smoke.py
 
 echo "ci_check: OK"

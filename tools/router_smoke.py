@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""CI router smoke: the serving replica tier's failover contract,
+driven through REAL replica subprocesses (ci_check.sh stage 9).
+
+Five stages, every assertion fatal (nonzero exit):
+
+  1. BASELINE — a router over 2 replica processes (cli/replica_main,
+     identical seeded params) completes a burst of shared-prefix
+     traffic; the per-request greedy tokens become the oracle for the
+     chaos arms (decode is deterministic, so ANY healthy tier must
+     reproduce them token-exactly).
+  2. replica_kill@req:N — a replica is SIGKILLed mid-traffic holding
+     in-flight work.  Bars: every accepted request completes
+     TOKEN-EXACT vs baseline, zero lost (no deadline, no shed), the
+     router failed over, the respawned replica (PR-4 budget machinery)
+     re-registers AND takes traffic, and `trace_main --check --allow
+     injected_fault --allow replica_lost` is green — the injected
+     fault and the router's reaction, nothing else.
+  3. net_partition@replica1:T — the router's health probes of replica
+     1 are dropped long enough to out-silence the health timeout (the
+     router sees timeouts, NOT a clean exit: the process never dies).
+     Bars: token-exactness + zero lost during the partition, and the
+     replica RE-REGISTERS when it heals (no respawn — same pid).
+  4. slow_replica@replica1:F — a straggler replica.  Bars:
+     token-exactness, zero lost, everything inside its deadline.
+  5. CLI — cli/router_main.py end-to-end (spawns its own tier),
+     exit 0 with every request completed.
+
+Usage: python tools/router_smoke.py [--keep DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+MODEL_FLAGS = [
+    "--model", "transformer_small", "--num_classes", "64",
+    "--serve_max_seq_len", "48", "--serve_max_batch", "4",
+    "--serve_queue_size", "32", "--heartbeat_secs", "0.2",
+    "--seed", "7",
+]
+PAGE = 16
+BUDGET = 8
+REQUESTS = 12
+
+
+def make_prompts():
+    """Shared-prefix burst: 2 'system prompts' of 2 full pages each,
+    per-request tails — the prefix-affine traffic shape."""
+    rng = np.random.default_rng(42)
+    groups = [rng.integers(0, 64, (2 * PAGE,)).astype(np.int32)
+              for _ in range(2)]
+    prompts = []
+    for i in range(REQUESTS):
+        tail = rng.integers(0, 64, (1 + i % 6,)).astype(np.int32)
+        prompts.append(np.concatenate([groups[i % 2], tail]))
+    return prompts
+
+
+def build_tier(workdir, *, fault_env=None, probe_s=0.25,
+               health_timeout_s=5.0, deadline_s=120.0):
+    """Router (in THIS process — router-side chaos fires here) over 2
+    replica_main subprocesses."""
+    from dtf_tpu.serve.router import Router, replica_spawner
+    rendezvous = os.path.join(workdir, "rdv")
+    trace_dir = os.path.join(workdir, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    cmd = [sys.executable, "-m", "dtf_tpu.cli.replica_main",
+           "--serve_random_init", "--rendezvous_dir", rendezvous,
+           *MODEL_FLAGS]
+    env_extra = {"DTF_TRACE_DIR": trace_dir}
+    if fault_env:
+        env_extra["DTF_FAULT"] = fault_env
+    spawn = replica_spawner(cmd, rendezvous, env_extra=env_extra)
+    router = Router(2, rendezvous, spawn=spawn, page_size=PAGE,
+                    probe_interval_s=probe_s,
+                    health_timeout_s=health_timeout_s,
+                    deadline_s=deadline_s, replica_inflight=32,
+                    respawn_backoff_s=0.2, max_respawns=4)
+    from dtf_tpu.obs import trace
+    trace.configure(trace_dir, stream="router")
+    t0 = time.time()
+    router.start(wait_s=600)
+    print(f"  tier up in {time.time() - t0:.1f}s")
+    return router, trace_dir
+
+
+def run_traffic(router, prompts):
+    """Submit the burst, resolve every handle.  Returns (tokens_per
+    request, outcome counts) — a TimeoutError here means a request
+    outlived deadline+30s UNANSWERED, the one thing the tier must
+    never do."""
+    from dtf_tpu.serve import Backpressure, DeadlineExceeded
+    handles = [router.submit(p, max_new_tokens=BUDGET) for p in prompts]
+    tokens, lost = [], 0
+    for h in handles:
+        try:
+            tokens.append(h.result(timeout=router.deadline_s + 30))
+        except (Backpressure, DeadlineExceeded) as e:
+            tokens.append(e)
+            lost += 1
+    return tokens, lost
+
+
+def teardown(router, trace_dir):
+    from dtf_tpu.obs import trace
+    router.stop(drain=True)
+    trace.disable()   # closes + flushes the router stream
+
+
+def check_trace(trace_dir, allow=("injected_fault", "replica_lost")):
+    cmd = [sys.executable, "-m", "dtf_tpu.cli.trace_main", trace_dir,
+           "--check"]
+    for kind in allow:
+        cmd += ["--allow", kind]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=REPO, timeout=120)
+    if proc.returncode != 0:
+        print(proc.stdout[-3000:], file=sys.stderr)
+        print(proc.stderr[-2000:], file=sys.stderr)
+        raise SystemExit(
+            f"trace check FAILED for {trace_dir} (allow={allow}) — the "
+            f"run contained unexpected anomalies")
+
+
+def assert_exact(tokens, baseline, stage):
+    for i, (got, want) in enumerate(zip(tokens, baseline)):
+        if isinstance(got, Exception):
+            raise SystemExit(
+                f"{stage}: request {i} was LOST ({got!r}) — zero lost "
+                f"requests is the bar")
+        if got.tokens != want:
+            raise SystemExit(
+                f"{stage}: request {i} diverged from the unfaulted "
+                f"baseline\n  want {want}\n  got  {got.tokens} "
+                f"(replica {got.replica}, {got.redispatches} "
+                f"re-dispatches)")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keep", default="",
+                    help="keep work dirs under this path (debug)")
+    args = ap.parse_args()
+    root = args.keep or tempfile.mkdtemp(prefix="dtf_router_smoke_")
+    os.makedirs(root, exist_ok=True)
+    from dtf_tpu import chaos
+    prompts = make_prompts()
+
+    # -- 1. baseline ----------------------------------------------------
+    print("router smoke [1/5]: baseline tier (2 replicas)")
+    chaos.disable()
+    router, tdir = build_tier(os.path.join(root, "baseline"))
+    results, lost = run_traffic(router, prompts)
+    if lost:
+        raise SystemExit(f"baseline: {lost} requests lost on a healthy "
+                         f"tier")
+    baseline = [r.tokens for r in results]
+    per_replica = [router.replica_completed(i) for i in range(2)]
+    teardown(router, tdir)
+    check_trace(tdir, allow=())
+    if min(per_replica) < 1:
+        raise SystemExit(f"baseline: traffic never spread "
+                         f"({per_replica}) — placement is broken")
+    print(f"  baseline OK: {len(baseline)} requests, per-replica "
+          f"{per_replica}")
+
+    # -- 2. replica_kill mid-traffic ------------------------------------
+    print("router smoke [2/5]: replica_kill@req:6 (SIGKILL mid-traffic "
+          "+ respawn)")
+    chaos.configure("replica_kill@req:6", rank=0)
+    router, tdir = build_tier(os.path.join(root, "kill"))
+    results, lost = run_traffic(router, prompts)
+    assert_exact(results, baseline, "replica_kill")
+    failovers = router.metrics.get("router_failover_total").value
+    respawns = router.metrics.get("router_replica_respawns_total").value
+    if respawns < 1:
+        raise SystemExit("replica_kill: the dead replica never respawned")
+    # the respawned replica must re-register and TAKE TRAFFIC: fresh
+    # prompts, concurrent burst, until both replicas complete new work
+    deadline = time.time() + 300
+    while time.time() < deadline and not all(
+            router.replica_healthy(i) for i in range(2)):
+        time.sleep(0.25)
+    if not all(router.replica_healthy(i) for i in range(2)):
+        raise SystemExit("replica_kill: respawned replica never "
+                         "re-registered")
+    before = [router.replica_completed(i) for i in range(2)]
+    rng = np.random.default_rng(77)
+    for wave in range(5):
+        wave_prompts = [rng.integers(0, 64, (6,)).astype(np.int32)
+                        for _ in range(8)]
+        _, lost2 = run_traffic(router, wave_prompts)
+        if lost2:
+            raise SystemExit("replica_kill: post-respawn wave lost "
+                             "requests")
+        after = [router.replica_completed(i) for i in range(2)]
+        if all(a > b for a, b in zip(after, before)):
+            break
+    else:
+        raise SystemExit(
+            f"replica_kill: respawned replica re-registered but took no "
+            f"traffic ({before} -> {after})")
+    teardown(router, tdir)
+    chaos.disable()
+    check_trace(tdir)
+    print(f"  kill OK: token-exact, 0 lost, failovers={failovers}, "
+          f"respawns={respawns}, post-respawn spread {after}")
+
+    # -- 3. net partition ------------------------------------------------
+    print("router smoke [3/5]: net_partition@replica1 (probe drops, "
+          "heal, re-register)")
+    # 32 ticks x 0.25s probe = 8s of silence vs the 5s health timeout
+    chaos.configure("net_partition@replica1:32", rank=0)
+    router, tdir = build_tier(os.path.join(root, "partition"))
+    results, lost = run_traffic(router, prompts)
+    assert_exact(results, baseline, "net_partition")
+    ann_before = json.load(open(os.path.join(
+        root, "partition", "rdv", "replica_rank1.json")))
+    deadline = time.time() + 120
+    while time.time() < deadline and not router.replica_healthy(1):
+        time.sleep(0.25)
+    if not router.replica_healthy(1):
+        raise SystemExit("net_partition: replica 1 never re-registered "
+                         "after the partition healed")
+    respawns = router.metrics.get("router_replica_respawns_total").value
+    if respawns != 0:
+        raise SystemExit(
+            f"net_partition: {respawns} respawns — a partition must look "
+            f"like timeouts, not a process death")
+    ann_after = json.load(open(os.path.join(
+        root, "partition", "rdv", "replica_rank1.json")))
+    if ann_after["pid"] != ann_before["pid"]:
+        raise SystemExit("net_partition: replica 1's pid changed — it "
+                         "was supposed to survive")
+    teardown(router, tdir)
+    chaos.disable()
+    check_trace(tdir)
+    print("  partition OK: token-exact, 0 lost, same pid re-registered")
+
+    # -- 4. slow replica -------------------------------------------------
+    print("router smoke [4/5]: slow_replica@replica1:4 (straggler)")
+    chaos.disable()
+    router, tdir = build_tier(os.path.join(root, "slow"),
+                              fault_env="slow_replica@replica1:4")
+    results, lost = run_traffic(router, prompts)
+    assert_exact(results, baseline, "slow_replica")
+    teardown(router, tdir)
+    check_trace(tdir)
+    print("  slow OK: token-exact, 0 lost, all inside deadline")
+
+    # -- 5. the CLI end-to-end -------------------------------------------
+    print("router smoke [5/5]: cli/router_main.py end-to-end")
+    cli_dir = os.path.join(root, "cli")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.cli.router_main",
+         "--serve_random_init", *MODEL_FLAGS,
+         "--router_replicas", "2", "--serve_requests", "8",
+         "--serve_max_new_tokens", str(BUDGET),
+         "--rendezvous_dir", os.path.join(cli_dir, "rdv")],
+        capture_output=True, text=True, cwd=REPO, timeout=900)
+    if proc.returncode != 0:
+        print(proc.stdout[-3000:], file=sys.stderr)
+        print(proc.stderr[-3000:], file=sys.stderr)
+        raise SystemExit("router_main CLI exited nonzero")
+    if "'completed': 8" not in proc.stdout + proc.stderr:
+        raise SystemExit("router_main CLI did not complete all 8 "
+                         "requests")
+    print("  CLI OK")
+
+    if not args.keep:
+        shutil.rmtree(root, ignore_errors=True)
+    print("router smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
