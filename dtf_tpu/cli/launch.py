@@ -103,11 +103,23 @@ def read_heartbeat(path: str):
 def build_env(rank: int, world: int, coordinator: str,
               devices_per_process: Optional[int] = None,
               heartbeat_dir: Optional[str] = None,
-              generation: int = 0) -> dict:
+              generation: int = 0,
+              trace_id: Optional[str] = None) -> dict:
     env = dict(os.environ)
     env["DTF_COORDINATOR"] = coordinator
     env["DTF_PROCESS_ID"] = str(rank)
     env["DTF_PROCESS_COUNT"] = str(world)
+    if trace_id:
+        # run-scoped trace id: every rank (and every restart attempt)
+        # of one supervised job shares it, so their trace records join
+        # one timeline (`trace_main --request <id>`).  The runner
+        # installs it as the process default trace
+        # (obs/trace.set_default_trace).  Unconditional: the per-job id
+        # is authoritative here — operator intent (an exported
+        # DTF_TRACE_ID) was already folded in when the job minted it,
+        # and a stale var lingering in os.environ must not fuse two
+        # jobs' timelines.
+        env["DTF_TRACE_ID"] = trace_id
     # restart generation (= supervisor attempt): the async-PS snapshot
     # tags its done_count with this, so a whole-job restart discards
     # the stale generation's DONE tally instead of double-counting it
@@ -134,7 +146,8 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
               heartbeat_timeout: Optional[float] = None,
               attempt: int = 0, startup_grace: float = 300.0,
               events: Optional[SupervisorEventLog] = None,
-              teardown_grace: float = 60.0) -> int:
+              teardown_grace: float = 60.0,
+              trace_id: Optional[str] = None) -> int:
     os.makedirs(log_dir, exist_ok=True)
     if events is None:
         events = SupervisorEventLog(log_dir)
@@ -177,7 +190,8 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                 cmd, env=build_env(rank, num_processes, coordinator,
                                    devices_per_process,
                                    heartbeat_dir=log_dir,
-                                   generation=attempt),
+                                   generation=attempt,
+                                   trace_id=trace_id),
                 stdout=f, stderr=subprocess.STDOUT)
             procs.append((rank, p))
             last_beat[rank] = spawned[rank] = time.monotonic()
@@ -315,6 +329,16 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
     Every decision lands in ``<log_dir>/supervisor_events.jsonl``.
     """
     os.makedirs(log_dir, exist_ok=True)
+    # run-scoped trace id, minted ONCE for the whole supervised job and
+    # handed to every rank (and every restart attempt) through
+    # build_env — all ranks' trace records share it, so `trace_main
+    # --request <id>` joins the cross-rank timeline.  An
+    # operator-exported DTF_TRACE_ID wins (correlate with an outer
+    # orchestrator); otherwise a local variable, not os.environ — an
+    # in-process caller launching several jobs (tests) must not have
+    # them share one id.  Stdlib-only (os.urandom), matching
+    # obs/trace.new_trace_id().
+    run_trace_id = os.environ.get("DTF_TRACE_ID") or os.urandom(8).hex()
     events = SupervisorEventLog(log_dir)
     supervising = bool(max_restarts) or heartbeat_timeout is not None
     attempt = 0
@@ -324,7 +348,8 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
         rc = _run_once(cmd, num_processes, coordinator, log_dir,
                        devices_per_process, stagger_s, heartbeat_timeout,
                        attempt=attempt, startup_grace=startup_grace,
-                       events=events, teardown_grace=teardown_grace)
+                       events=events, teardown_grace=teardown_grace,
+                       trace_id=run_trace_id)
         cls = classify_exit(rc)
         if cls == "ok":
             events.emit("job_done", attempts=attempt)
@@ -385,10 +410,15 @@ def cluster_commands(cmd: List[str], hosts: List[str], coordinator: str,
     status is observable."""
     world = len(hosts)
     quoted = " ".join(shlex.quote(c) for c in cmd)
+    # one run-scoped trace id for the WHOLE cluster job (same contract
+    # as launch_local): every host's rank inherits it, so their trace
+    # records join one timeline.  An operator-exported DTF_TRACE_ID
+    # wins — correlate with an outer orchestrator by exporting it.
+    trace_id = os.environ.get("DTF_TRACE_ID") or os.urandom(8).hex()
     lines = []
     for rank, host in enumerate(hosts):
         envs = (f"DTF_COORDINATOR={coordinator} DTF_PROCESS_ID={rank} "
-                f"DTF_PROCESS_COUNT={world}")
+                f"DTF_PROCESS_COUNT={world} DTF_TRACE_ID={trace_id}")
         logfile = shlex.quote(f"{log_dir}/log{rank}.log")
         remote = (f"mkdir -p {shlex.quote(log_dir)} && {envs} {quoted} "
                   f"> {logfile} 2>&1")

@@ -79,6 +79,20 @@ def run_replica(cfg, random_init: bool = False,
     log.info("replica %d: warm (compile done)", replica_id)
     server = ReplicaServer(engine, replica_id, cfg.rendezvous_dir)
 
+    # --metrics_port: this replica's engine registry (queue depth,
+    # prefix hits, decode-step MFU ledger gauges) as a live Prometheus
+    # scrape + a /healthz probe (503 once draining).  Each replica is
+    # its own process/port; router_main fans out base+1+K
+    metrics_server = None
+    if cfg.metrics_port:
+        from dtf_tpu.obs.prom import MetricsServer
+        metrics_server = MetricsServer(
+            cfg.metrics_port, registry_fn=lambda: engine.metrics,
+            health_fn=lambda: {"ok": not engine.draining,
+                               "replica": replica_id,
+                               "draining": engine.draining,
+                               "outstanding": engine.outstanding})
+
     done = threading.Event()
 
     def _on_sigterm(signum, frame):
@@ -102,6 +116,8 @@ def run_replica(cfg, random_init: bool = False,
         engine.stop(drain=True)
     finally:
         server.stop()
+        if metrics_server is not None:
+            metrics_server.shutdown()
     log.info("replica %d: drained — exiting 0", replica_id)
     return 0
 

@@ -88,8 +88,17 @@ def run_router(cfg, random_init: bool = False) -> dict:
         env_extra["DTF_TRACE_DIR"] = os.path.abspath(cfg.trace_dir)
     if cfg.fault:
         env_extra["DTF_FAULT"] = cfg.fault
+    # --metrics_port N makes the WHOLE tier scrapable from one flag:
+    # the router serves its registry on N, replica K on N+1+K (each is
+    # a separate process — one port each), every endpoint with a
+    # /healthz probe
+    extra_flags = None
+    if cfg.metrics_port:
+        extra_flags = (lambda rid:
+                       ["--metrics_port", str(cfg.metrics_port + 1 + rid)])
     spawn = replica_spawner(replica_command(cfg, random_init),
-                            rendezvous, env_extra=env_extra)
+                            rendezvous, env_extra=env_extra,
+                            extra_flags=extra_flags)
     router = Router(
         cfg.router_replicas, rendezvous, spawn=spawn,
         page_size=cfg.kv_page_size or 16,
@@ -115,18 +124,28 @@ def run_router(cfg, random_init: bool = False) -> dict:
     except ValueError:
         pass
 
+    metrics_server = None
+    if cfg.metrics_port:
+        from dtf_tpu.obs.prom import MetricsServer
+        metrics_server = MetricsServer(
+            cfg.metrics_port, registry_fn=lambda: router.metrics,
+            health_fn=router.health)
+
     log.info("router: spawning %d replicas (rendezvous %s)",
              cfg.router_replicas, rendezvous)
     # first-compile on a CPU replica can take minutes; the wait only
     # ends early when every replica heartbeats + announces.  From here
     # on the tier must come down with us — a traffic-loop exception
     # must not leave N serve processes running
-    router.start(wait_s=600.0)
     try:
+        router.start(wait_s=600.0)
         return _drive_traffic(cfg, router)
     except BaseException:
         router.stop(drain=False)
         raise
+    finally:
+        if metrics_server is not None:
+            metrics_server.shutdown()
 
 
 def _drive_traffic(cfg, router) -> dict:

@@ -135,6 +135,12 @@ def run(cfg: Config) -> dict:
     from dtf_tpu.obs import trace
     from dtf_tpu.train import preemption
     trace.maybe_configure(cfg)
+    # run-scoped trace id: the launcher mints one (DTF_TRACE_ID) so
+    # every rank's records — steps, checkpoints, eval, data service,
+    # PS — share it and `trace_main --request <id>` joins them into
+    # one timeline; a standalone run mints its own
+    trace.set_default_trace(os.environ.get("DTF_TRACE_ID")
+                            or trace.new_trace_id())
     chaos.maybe_configure(cfg)
     preemption.install()
     poller = None

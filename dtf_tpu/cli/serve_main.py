@@ -121,6 +121,17 @@ def serve(cfg, random_init: bool = False) -> dict:
 
     model, engine = build_serving_engine(cfg, random_init=random_init)
 
+    # --metrics_port: the engine registry (queue depth, prefix hits,
+    # decode-step MFU ledger gauges) live over Prometheus + /healthz
+    metrics_server = None
+    if cfg.metrics_port:
+        from dtf_tpu.obs.prom import MetricsServer
+        metrics_server = MetricsServer(
+            cfg.metrics_port, registry_fn=lambda: engine.metrics,
+            health_fn=lambda: {"ok": not engine.draining,
+                               "draining": engine.draining,
+                               "outstanding": engine.outstanding})
+
     # serve drain: SIGTERM (the preemption signal) stops admissions —
     # new submits shed with retry_after — finishes in-flight decodes,
     # and the process exits 0 (a drained replica is a clean exit the
@@ -188,6 +199,8 @@ def serve(cfg, random_init: bool = False) -> dict:
     finally:
         if old_handler is not None:
             signal.signal(signal.SIGTERM, old_handler)
+        if metrics_server is not None:
+            metrics_server.shutdown()
     if drained["signaled"]:
         log.info("serve: drained after SIGTERM (%d in-flight finished, "
                  "%d shed) — exiting 0", len(handles), shed_by_drain)

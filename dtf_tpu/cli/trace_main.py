@@ -8,6 +8,23 @@ max), event counts, and every anomaly record.
 Usage:
   python -m dtf_tpu.cli.trace_main <trace_dir | trace.jsonl> [...]
       [--check] [--allow <kind>]... [--json] [--merge]
+      [--request <trace_id>] [--ledger]
+
+``--request <trace_id>`` reconstructs ONE request's (or one run's)
+cross-process timeline: every record whose ``trace`` is the id — or
+whose batch-span ``traces`` list contains it — from every rank and
+named stream, time-ordered with relative offsets.  The view that
+answers "where did request X spend its time?": router queue wait →
+dispatch → replica prefill chunks → decode steps → failover
+re-dispatch → stream delivery → completion, each line rank-tagged.
+Composes with ``--merge`` (emit the filtered records as raw JSONL
+instead of the rendered timeline).  Exits 2 when the id appears in no
+record.
+
+``--ledger`` renders the MFU/cost ledger (obs/ledger.py) from the
+trace stream's ``ledger_exec``/``ledger_summary`` events: one row per
+(rank, executable) with XLA FLOPs/bytes, measured mean wall time,
+achieved TFLOP/s, MFU, and HBM-bandwidth fraction.
 
 ``--merge`` emits ONE time-ordered cross-rank stream (JSONL on stdout)
 instead of the aggregate table: every record from every
@@ -61,6 +78,21 @@ KNOWN_ANOMALY_KINDS = (
     "replica_kill", "net_partition", "slow_replica",
 )
 
+#: event kinds of the request-timeline / ledger / profiler layer —
+#: never anomalies, but part of the vocabulary the --allow typo check
+#: validates against: `--allow serve_retire` is a harmless no-op on a
+#: known name, while `--allow serve_retier` still warns loudly
+KNOWN_EVENT_KINDS = (
+    # request-scoped distributed tracing (router + serve engine)
+    "router_submit", "router_dispatch", "router_requeue",
+    "router_first_token", "router_complete", "router_hedge",
+    "serve_submit", "serve_admit", "serve_retire",
+    # MFU/cost ledger (obs/ledger.py)
+    "ledger_exec", "ledger_summary",
+    # --profile_steps output-path marker (train/loop.py)
+    "profiler_trace",
+)
+
 
 def discover(paths: List[str]) -> List[str]:
     """Expand directories to their trace files: per-rank
@@ -111,12 +143,92 @@ def merge_records(files: List[str]) -> List[dict]:
     return merged
 
 
+def request_records(merged: List[dict], trace_id: str) -> List[dict]:
+    """The subset of a merged stream belonging to one trace id —
+    records tagged directly (``trace``) or via a batch span's
+    ``traces`` list (one decode step serves many requests)."""
+    out = []
+    for rec in merged:
+        if rec.get("trace") == trace_id:
+            out.append(rec)
+        else:
+            traces = rec.get("traces")
+            if traces and trace_id in traces:
+                out.append(rec)
+    return out
+
+
+#: timeline rendering: drop the plumbing keys, keep the payload
+_TIMELINE_HIDE = ("kind", "name", "ts", "rank", "trace", "traces",
+                  "dur_s", "span_id", "parent_span", "parent")
+
+
+def print_request_timeline(trace_id: str, recs: List[dict]) -> None:
+    """One request's cross-process life, time-ordered with offsets
+    relative to its first record."""
+    t0 = min(float(r.get("ts", 0.0)) for r in recs)
+    t1 = max(float(r.get("ts", 0.0)) + float(r.get("dur_s", 0.0))
+             for r in recs)
+    ranks = sorted({str(r.get("rank", "?")) for r in recs})
+    print(f"trace {trace_id}: {len(recs)} records across ranks "
+          f"{ranks}, {t1 - t0:.3f}s end to end")
+    for r in recs:
+        rel = float(r.get("ts", 0.0)) - t0
+        kind = r.get("kind", "?")
+        name = r.get("name", "?")
+        dur = (f" ({float(r['dur_s']) * 1e3:.1f}ms)"
+               if kind == "span" and "dur_s" in r else "")
+        detail = {k: v for k, v in r.items() if k not in _TIMELINE_HIDE}
+        tag = "ANOMALY " if kind == "anomaly" else ""
+        print(f"  +{rel:8.3f}s [{str(r.get('rank', '?')):>6}] "
+              f"{tag}{name}{dur} {detail if detail else ''}")
+
+
+def print_ledger(merged: List[dict]) -> bool:
+    """The MFU/cost ledger table from ledger_exec/ledger_summary
+    events — latest record per (rank, executable) wins (a re-compile
+    or a later summary supersedes).  Returns False when the stream
+    carries no ledger records at all."""
+    rows: Dict[tuple, dict] = {}
+    for rec in merged:
+        if rec.get("name") == "ledger_exec":
+            key = (str(rec.get("rank", "?")), rec.get("exec", "?"))
+            rows.setdefault(key, {}).update(
+                flops=rec.get("flops"), bytes=rec.get("bytes"))
+        elif rec.get("name") == "ledger_summary":
+            key = (str(rec.get("rank", "?")), rec.get("exec", "?"))
+            rows.setdefault(key, {}).update(
+                count=rec.get("count"), mean_s=rec.get("mean_s"),
+                achieved_tflops=rec.get("achieved_tflops"),
+                mfu=rec.get("mfu"), hbm_frac=rec.get("hbm_frac"))
+    if not rows:
+        return False
+
+    def fmt(v, spec):
+        return format(v, spec) if isinstance(v, (int, float)) else "-"
+
+    hdr = (f"{'rank':<7}{'executable':<28}{'gflops':>9}{'calls':>7}"
+           f"{'mean_ms':>9}{'tflop/s':>9}{'mfu':>7}{'hbm':>7}")
+    print(hdr)
+    print("-" * len(hdr))
+    for (rank, name), r in sorted(rows.items()):
+        print(f"{rank:<7}{name:<28}"
+              f"{fmt((r.get('flops') or 0) / 1e9, '9.1f'):>9}"
+              f"{fmt(r.get('count'), 'd'):>7}"
+              f"{fmt((r.get('mean_s') or 0) * 1e3, '9.2f'):>9}"
+              f"{fmt(r.get('achieved_tflops'), '.2f'):>9}"
+              f"{fmt(r.get('mfu'), '.3f'):>7}"
+              f"{fmt(r.get('hbm_frac'), '.3f'):>7}")
+    return True
+
+
 def summarize(files: List[str]) -> dict:
     spans: Dict[str, Histogram] = {}
     events: CCounter = CCounter()
     anomalies: List[dict] = []
     ranks = set()
     steps = set()
+    profiler_traces: List[str] = []
     for path in files:
         for rec in read_records(path):
             ranks.add(rec.get("rank", 0))
@@ -131,6 +243,11 @@ def summarize(files: List[str]) -> dict:
                     steps.add((rec.get("rank", 0), rec["step"]))
             elif kind == "event":
                 events[rec.get("name", "?")] += 1
+                if rec.get("name") == "profiler_trace":
+                    # --profile_steps dumped an XLA trace: surface where
+                    path_ = str(rec.get("path", ""))
+                    if path_ and path_ not in profiler_traces:
+                        profiler_traces.append(path_)
             elif kind == "anomaly":
                 anomalies.append(rec)
     span_rows = {}
@@ -149,6 +266,7 @@ def summarize(files: List[str]) -> dict:
         "spans": span_rows,
         "events": dict(sorted(events.items())),
         "anomalies": anomalies,
+        "profiler_traces": profiler_traces,
     }
 
 
@@ -169,6 +287,8 @@ def print_summary(summary: dict, allowed=()) -> None:
     if summary["events"]:
         print("events: " + ", ".join(f"{k}×{v}"
                                      for k, v in summary["events"].items()))
+    for path in summary.get("profiler_traces", ()):
+        print(f"profiler trace: {path}")
     for a in summary["anomalies"]:
         detail = {k: v for k, v in a.items()
                   if k not in ("kind", "name", "ts")}
@@ -196,18 +316,49 @@ def main(argv=None) -> int:
     ap.add_argument("--merge", action="store_true",
                     help="emit one time-ordered cross-rank JSONL stream "
                          "(rank-tagged records) instead of the summary")
+    ap.add_argument("--request", default="", metavar="TRACE_ID",
+                    help="reconstruct one trace id's cross-process "
+                         "timeline (with --merge: emit its records as "
+                         "raw JSONL); exits 2 when the id is unknown")
+    ap.add_argument("--ledger", action="store_true",
+                    help="render the MFU/cost ledger table from the "
+                         "stream's ledger_exec/ledger_summary events")
     args = ap.parse_args(argv)
 
     files = discover(args.paths)
     allowed = set(args.allow)
-    for kind in sorted(allowed - set(KNOWN_ANOMALY_KINDS)):
+    for kind in sorted(allowed - set(KNOWN_ANOMALY_KINDS)
+                       - set(KNOWN_EVENT_KINDS)):
         # warn, don't fail: new subsystems may emit kinds this registry
         # hasn't learned — but a typo'd --allow silently tolerating
         # nothing is exactly the bug an expected-anomaly list invites
         print(f"warning: --allow {kind!r} is not a known anomaly kind "
               f"(known: {', '.join(KNOWN_ANOMALY_KINDS)})",
               file=sys.stderr)
-    if args.merge:
+    if args.request:
+        merged = merge_records(files)
+        recs = request_records(merged, args.request)
+        if not recs:
+            print(f"trace id {args.request!r} appears in no record "
+                  f"under {args.paths}", file=sys.stderr)
+            return 2
+        if args.merge:
+            for rec in recs:
+                print(json.dumps(rec, default=str))
+        else:
+            print_request_timeline(args.request, recs)
+        # --check still scans the WHOLE stream: a clean request inside
+        # a dirty run is not a clean run
+        anomalies = [r for r in merged if r.get("kind") == "anomaly"]
+    elif args.ledger:
+        merged = merge_records(files)
+        if not print_ledger(merged):
+            print("no ledger records in this trace (ledger_exec/"
+                  "ledger_summary events are emitted by instrumented "
+                  "train/serve runs)", file=sys.stderr)
+            return 2
+        anomalies = [r for r in merged if r.get("kind") == "anomaly"]
+    elif args.merge:
         # one pass over the files: the merged stream also feeds the
         # --check anomaly scan (no summarize — the aggregate view is
         # never printed in merge mode)

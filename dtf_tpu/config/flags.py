@@ -360,9 +360,13 @@ class Config:
     # heartbeat file rewrite interval (launcher supervision); the file
     # is only written when the launcher exports DTF_HEARTBEAT_DIR
     heartbeat_secs: float = 5.0
-    # live scrape endpoint: rank 0 serves the obs registry as
-    # Prometheus text format over stdlib http.server on this port
-    # (GET /metrics).  0 = off (the default)
+    # live scrape endpoint: the owning registry as Prometheus text
+    # over stdlib http.server on this port (GET /metrics) plus a
+    # GET /healthz JSON probe (200/503).  Train: rank 0, the default
+    # registry.  router_main: the router registry on this port and
+    # replica K's engine registry on port+1+K (one flag makes the
+    # whole tier scrapable).  replica_main standalone: the engine
+    # registry.  0 = off (the default)
     metrics_port: int = 0
     # poll the GCE/TPU metadata preemption endpoint every N seconds in
     # a daemon thread; a pending preemption feeds the SIGTERM latch

@@ -20,6 +20,12 @@ structured, near-zero-overhead vocabulary:
               loss (loud structured abort), step-time regression
               (rolling-median × factor), and heartbeat files the
               launcher supervisor consumes instead of scraping stdout.
+  ledger    — always-on MFU/cost accounting: each jitted executable's
+              XLA flop/byte counts (pulled at compile time from the
+              AOT executable the caller then runs) joined with
+              measured wall time into achieved-FLOP/s, MFU, and
+              HBM-bandwidth-fraction gauges; summarized by
+              `trace_main --ledger`.
 
 Everything is pure Python and off-device: instrumentation runs on the
 host at step boundaries only, and every entry point is a no-op when
@@ -28,6 +34,7 @@ assertion on a smoke-train step).
 """
 
 from dtf_tpu.obs import trace
+from dtf_tpu.obs.ledger import Ledger
 from dtf_tpu.obs.registry import (Counter, Gauge, Histogram,
                                   MetricsRegistry, default_registry)
 from dtf_tpu.obs.watchdog import (Heartbeat, NanLossWatchdog,
@@ -36,7 +43,7 @@ from dtf_tpu.obs.watchdog import (Heartbeat, NanLossWatchdog,
 
 __all__ = [
     "trace",
-    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "Counter", "Gauge", "Histogram", "Ledger", "MetricsRegistry",
     "default_registry",
     "Heartbeat", "NanLossWatchdog", "ReaderLagWatchdog",
     "StepTimeWatchdog", "TrainingAnomaly",

@@ -1,0 +1,218 @@
+"""Always-on MFU/cost ledger — per-executable FLOPs, bytes, and
+achieved-utilization gauges.
+
+bench_profile.py proved the attribution method offline: XLA's own
+``compiled.cost_analysis()`` (flops, bytes accessed) for exactly the
+program that runs, divided by measured wall time, against the chip's
+peak FLOP/s and HBM bandwidth.  This module makes the same accounting
+LIVE: the train loop and the serving decoder register each jitted
+executable at compile time (the AOT ``lower().compile()`` object they
+then EXECUTE — cost analysis is free, nothing compiles twice), feed it
+their already-measured wall times, and the ledger exports
+
+  ledger_<exec>_flops            gauge   XLA flop count (per device)
+  ledger_<exec>_bytes            gauge   XLA bytes accessed (per device)
+  ledger_<exec>_wall_s           gauge   running-mean measured wall time
+  ledger_<exec>_calls            gauge   observations folded in
+  ledger_<exec>_achieved_tflops  gauge   flops / mean wall / 1e12
+  ledger_<exec>_mfu              gauge   achieved / peak FLOP/s
+  ledger_<exec>_hbm_frac         gauge   achieved bytes/s / peak HBM b/s
+
+into whatever registry owns the subsystem (the engine's
+``engine.metrics``, train's default registry) — scraped live via the
+Prometheus endpoint (``--metrics_port``), exported post-run through
+``BenchmarkFileLogger.log_registry``.  Registration and summaries also
+land in the trace stream (``ledger_exec`` / ``ledger_summary`` events),
+so ``trace_main --ledger`` renders the table from trace files alone.
+
+Peaks come from the device kind (the same public-spec tables bench.py
+and bench_profile.py carry); unknown kinds (CPU) export no mfu/hbm_frac
+rather than a made-up number.  ``DTF_PEAK_TFLOPS`` / ``DTF_PEAK_HBM_GBPS``
+override both — deterministic tests, and chips the table hasn't learned.
+
+Accuracy contract (documented tolerance): the train-step wall time is
+the log-window mean (sync-inclusive, measured across a device_get), so
+ledger MFU sits within ~20% of bench.py's sync-cancelled-window MFU —
+host dispatch overhead is IN the ledger's number, deliberately (it is
+utilization the run actually achieves, not the kernel's best case).
+Chunked-prefill entries are per chunk SHAPE; on the gather path several
+window variants share one name and the latest compile's counts stand
+for the family (serving's headline is the decode-step entry).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+from typing import Dict, Optional
+
+from dtf_tpu.obs import trace
+from dtf_tpu.obs.registry import MetricsRegistry, default_registry
+
+log = logging.getLogger("dtf_tpu")
+
+# Public-spec peaks by TPU generation, matched case-insensitively
+# against jax device_kind — the same numbers bench.py (bf16 TFLOP/s)
+# and bench_profile.py (HBM GB/s) carry; kept here as literals because
+# obs must import without the bench scripts on sys.path (parity pinned
+# by tests/test_obs.py).
+PEAK_BF16_TFLOPS = {
+    "v6e": 918.0, "v6": 918.0,
+    "v5p": 459.0,
+    "v5 lite": 197.0, "v5e": 197.0, "v5litepod": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
+PEAK_HBM_GBPS = {
+    "v5 lite": 819.0, "v5e": 819.0, "v4": 1228.0, "v5p": 2765.0,
+    "v6e": 1640.0,
+}
+
+
+def _lookup(table: dict, kind: str) -> Optional[float]:
+    kind = kind.lower()
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return None
+
+
+def device_peaks() -> tuple:
+    """(peak FLOP/s, peak HBM bytes/s) of the attached device — or
+    (None, None) when unknown.  Env overrides DTF_PEAK_TFLOPS /
+    DTF_PEAK_HBM_GBPS win (tests, unlisted chips); jax is imported
+    lazily and failures degrade to unknown, never to a crash."""
+    tflops = os.environ.get("DTF_PEAK_TFLOPS", "")
+    gbps = os.environ.get("DTF_PEAK_HBM_GBPS", "")
+    peak_f = float(tflops) * 1e12 if tflops else None
+    peak_b = float(gbps) * 1e9 if gbps else None
+    if peak_f is None or peak_b is None:
+        try:
+            import jax
+            kind = getattr(jax.devices()[0], "device_kind", "")
+        except Exception:  # noqa: BLE001 — diagnostics never crash a run
+            kind = ""
+        if peak_f is None:
+            t = _lookup(PEAK_BF16_TFLOPS, kind)
+            peak_f = t * 1e12 if t else None
+        if peak_b is None:
+            g = _lookup(PEAK_HBM_GBPS, kind)
+            peak_b = g * 1e9 if g else None
+    return peak_f, peak_b
+
+
+def cost_of(compiled) -> tuple:
+    """(flops, bytes accessed) from a compiled executable's
+    cost_analysis — the bench_profile.py extraction, shared."""
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    return (float(ca.get("flops", 0.0) or 0.0),
+            float(ca.get("bytes accessed", 0.0) or 0.0))
+
+
+class Ledger:
+    """Per-executable cost ledger over one metrics registry.
+
+    ``register(name, compiled=...)`` once per executable at compile
+    time; ``observe(name, wall_s)`` with each measured wall time the
+    caller already has (decode steps sync per step; the train loop's
+    log windows span a real device sync).  ``emit_summary()`` flushes
+    one ``ledger_summary`` trace event per executable — call it at
+    run/engine teardown so ``trace_main --ledger`` works from the
+    trace directory alone."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._mu = threading.Lock()
+        self._execs: Dict[str, dict] = {}
+        self.peak_flops, self.peak_hbm = device_peaks()
+
+    def register(self, name: str, compiled=None, flops: float = 0.0,
+                 bytes_accessed: float = 0.0) -> None:
+        """Record an executable's static cost.  ``compiled`` is an AOT
+        ``lower().compile()`` object (cost pulled from XLA); without
+        one, pass the counts directly.  Re-registering the same name
+        (gather-path chunk window variants) updates the counts and
+        keeps the accumulated timing."""
+        if compiled is not None:
+            try:
+                flops, bytes_accessed = cost_of(compiled)
+            except Exception as e:  # noqa: BLE001 — a backend without
+                # cost_analysis must not take down the step it measures
+                log.debug("ledger: cost_analysis unavailable for %s (%s)",
+                          name, e)
+                return
+        with self._mu:
+            e = self._execs.get(name)
+            if e is None:
+                e = self._execs[name] = {"flops": 0.0, "bytes": 0.0,
+                                         "count": 0, "total_s": 0.0}
+            e["flops"] = float(flops)
+            e["bytes"] = float(bytes_accessed)
+        self.registry.gauge(f"ledger_{name}_flops",
+                            unit="flops").set(flops)
+        self.registry.gauge(f"ledger_{name}_bytes",
+                            unit="bytes").set(bytes_accessed)
+        trace.event("ledger_exec", exec=name, flops=float(flops),
+                    bytes=float(bytes_accessed),
+                    peak_tflops=(self.peak_flops / 1e12
+                                 if self.peak_flops else None),
+                    peak_hbm_gbps=(self.peak_hbm / 1e9
+                                   if self.peak_hbm else None))
+
+    def observe(self, name: str, wall_s: float) -> None:
+        """Fold one measured wall time into the executable's gauges.
+        Unregistered names and non-positive times are ignored (the
+        caller's timing sites outlive registration failures)."""
+        if not wall_s or wall_s <= 0 or not math.isfinite(wall_s):
+            return
+        with self._mu:
+            e = self._execs.get(name)
+            if e is None:
+                return
+            e["count"] += 1
+            e["total_s"] += float(wall_s)
+            mean = e["total_s"] / e["count"]
+            flops, nbytes, count = e["flops"], e["bytes"], e["count"]
+        g = self.registry.gauge
+        g(f"ledger_{name}_wall_s", unit="s").set(mean)
+        g(f"ledger_{name}_calls", unit="calls").set(count)
+        achieved = flops / mean if mean > 0 else 0.0
+        g(f"ledger_{name}_achieved_tflops",
+          unit="tflops").set(achieved / 1e12)
+        if self.peak_flops:
+            g(f"ledger_{name}_mfu", unit="mfu").set(
+                achieved / self.peak_flops)
+        if self.peak_hbm and mean > 0:
+            g(f"ledger_{name}_hbm_frac", unit="fraction").set(
+                nbytes / mean / self.peak_hbm)
+
+    def summary(self) -> Dict[str, dict]:
+        """{exec: {flops, bytes, count, mean_s, achieved_tflops, mfu,
+        hbm_frac}} — mfu/hbm_frac None when the peak is unknown."""
+        out: Dict[str, dict] = {}
+        with self._mu:
+            items = sorted(self._execs.items())
+        for name, e in items:
+            mean = e["total_s"] / e["count"] if e["count"] else 0.0
+            achieved = e["flops"] / mean if mean > 0 else 0.0
+            out[name] = {
+                "flops": e["flops"], "bytes": e["bytes"],
+                "count": e["count"], "mean_s": mean,
+                "achieved_tflops": achieved / 1e12,
+                "mfu": (achieved / self.peak_flops
+                        if self.peak_flops and mean > 0 else None),
+                "hbm_frac": (e["bytes"] / mean / self.peak_hbm
+                             if self.peak_hbm and mean > 0 else None),
+            }
+        return out
+
+    def emit_summary(self) -> None:
+        """One ``ledger_summary`` trace event per executable — the
+        record ``trace_main --ledger`` tabulates."""
+        for name, s in self.summary().items():
+            trace.event("ledger_summary", exec=name, **s)

@@ -16,14 +16,19 @@ embedded units, e.g. ``data_reader_lag_s``):
                 registry's reservoir percentiles, plus <name>_sum /
                 <name>_count
 
-Scrape surface: ``GET /metrics`` (and ``/`` as an alias).  The
-registry is re-snapshotted per request — the server holds a callable,
-not a frozen snapshot, so `MetricsRegistry.reset()` between runs in
-one process is reflected immediately.
+Scrape surface: ``GET /metrics`` (and ``/`` as an alias) plus
+``GET /healthz`` — a JSON liveness probe for external health checkers
+(k8s-style): 200 ``{"ok": true, ...}`` while healthy, 503 when the
+optional ``health_fn`` reports ``ok: false`` (a draining replica, a
+router whose every replica is lost).  The registry is re-snapshotted
+per request — the server holds a callable, not a frozen snapshot, so
+`MetricsRegistry.reset()` between runs in one process is reflected
+immediately; ``health_fn`` is likewise re-evaluated per probe.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import math
 import threading
@@ -75,16 +80,37 @@ class MetricsServer:
 
     ``port=0`` binds an ephemeral port (tests); the bound port is
     ``.port``.  ``registry_fn`` defaults to the process-global default
-    registry, resolved per request."""
+    registry, resolved per request.  ``health_fn`` (optional) returns a
+    dict merged into the ``/healthz`` JSON; ``{"ok": False, ...}``
+    turns the probe into a 503 so external checkers (and the chaos
+    matrix) can distinguish alive-but-degraded from healthy."""
 
     def __init__(self, port: int,
                  registry_fn: Optional[Callable[[], MetricsRegistry]]
-                 = None, host: str = ""):
+                 = None, host: str = "",
+                 health_fn: Optional[Callable[[], dict]] = None):
         registry_fn = registry_fn or default_registry
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server contract
-                if self.path.split("?")[0] not in ("/", "/metrics"):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    payload = {"ok": True}
+                    if health_fn is not None:
+                        try:
+                            payload.update(health_fn() or {})
+                        except Exception as e:  # noqa: BLE001 — a probe
+                            # must answer, not 500 into a flapping check
+                            payload = {"ok": False, "error": str(e)}
+                    body = (json.dumps(payload) + "\n").encode()
+                    self.send_response(200 if payload.get("ok", True)
+                                       else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path not in ("/", "/metrics"):
                     self.send_error(404)
                     return
                 body = prometheus_text(registry_fn()).encode()

@@ -30,11 +30,36 @@ The tracer is configured once per process — from ``--trace_dir`` via
 variable that the launcher forwards to every rank.  Rank identity comes
 from config/env (``DTF_PROCESS_ID``), NOT from jax — importing this
 module must never initialize a backend.
+
+SPAN CONTEXT (request-scoped distributed tracing): every record can
+carry a ``trace`` id that survives process boundaries, so one request's
+life — router queue, dispatch, replica prefill/decode, failover,
+completion — is reconstructable across N trace files
+(``trace_main --request <id>``).  Three propagation layers:
+
+  - explicit attrs win: ``trace.event("x", trace=tid)`` — the serving
+    tier tags per-request records this way (one engine iteration
+    serves MANY requests, so ambient context can't express it; batch
+    spans carry a ``traces`` list instead).
+  - thread-local :func:`context` — ``with trace.context(tid, parent):``
+    stamps every record emitted under it.
+  - process-wide :func:`set_default_trace` — the RUN-scoped id the
+    launcher mints once (``DTF_TRACE_ID``) and every rank inherits, so
+    train steps, checkpoint saves, eval and data-service records join
+    one timeline without per-call plumbing.
+
+Spans additionally get a process-unique ``span_id`` (rank-qualified
+counter — no syscalls) and a ``parent_span`` id when nested; a parent
+id crossing a process boundary (the router's per-request span id,
+carried over the replica wire) lands via ``parent_span`` too, which is
+what makes the context *propagatable* rather than merely ambient.
 """
 
 from __future__ import annotations
 
 import atexit
+import contextlib
+import itertools
 import json
 import os
 import threading
@@ -43,6 +68,51 @@ from typing import Any, Dict, List, Optional
 
 _tracer: Optional["Tracer"] = None
 _lock = threading.Lock()
+_local = threading.local()
+_default_trace: Optional[str] = None
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex trace id (collision-safe across processes)."""
+    return os.urandom(8).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id for callers that need one BEFORE any span
+    opens (the router mints one per request and sends it over the wire
+    as the replica-side records' ``parent_span``)."""
+    return os.urandom(4).hex()
+
+
+def set_default_trace(trace_id: Optional[str]) -> None:
+    """Install the process-wide run-scoped trace id (None clears it).
+    Stamped on every record that carries no explicit/contextual
+    trace — the train-side 'everything in this run joins up' layer."""
+    global _default_trace
+    _default_trace = trace_id or None
+
+
+def default_trace() -> Optional[str]:
+    return _default_trace
+
+
+@contextlib.contextmanager
+def context(trace_id: Optional[str], parent: Optional[str] = None):
+    """Thread-local span context: records emitted under it default
+    their ``trace`` (and ``parent_span``) to these ids.  Nests; inner
+    contexts shadow outer ones; explicit attrs always win."""
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = (trace_id, parent)
+    try:
+        yield
+    finally:
+        _local.ctx = prev
+
+
+def current_context():
+    """(trace_id, parent_span) of the active :func:`context`, or
+    None."""
+    return getattr(_local, "ctx", None)
 
 
 class _NullSpan:
@@ -61,7 +131,7 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("_tracer", "name", "attrs", "t0")
+    __slots__ = ("_tracer", "name", "attrs", "t0", "span_id")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
         self._tracer = tracer
@@ -69,7 +139,8 @@ class _Span:
         self.attrs = attrs
 
     def __enter__(self):
-        self._tracer._stack().append(self.name)
+        self.span_id = self._tracer._next_span_id()
+        self._tracer._stack().append((self.name, self.span_id))
         self.t0 = time.time()
         return self
 
@@ -78,9 +149,12 @@ class _Span:
         stack = self._tracer._stack()
         stack.pop()
         rec = {"kind": "span", "name": self.name, "ts": self.t0,
-               "dur_s": dur}
+               "dur_s": dur, "span_id": self.span_id}
         if stack:
-            rec["parent"] = stack[-1]
+            # parent name kept for the summarizer's nesting view;
+            # parent_span is the id link the request timeline follows
+            rec["parent"] = stack[-1][0]
+            rec["parent_span"] = stack[-1][1]
         if exc_type is not None:
             rec["error"] = exc_type.__name__
         if self.attrs:
@@ -104,6 +178,7 @@ class Tracer:
         self._buf: List[str] = []
         self._mu = threading.Lock()
         self._local = threading.local()
+        self._span_ids = itertools.count(1)
         self.emit({"kind": "event", "name": "trace_start", "ts": time.time(),
                    "pid": os.getpid()})
 
@@ -113,9 +188,25 @@ class Tracer:
             st = self._local.stack = []
         return st
 
+    def _next_span_id(self) -> str:
+        # rank-qualified counter: unique across a run's processes with
+        # no per-span syscall (os.urandom per step would be real cost)
+        return f"{self.rank}.{next(self._span_ids)}"
+
     # -- record emission ----------------------------------------------
     def emit(self, record: Dict[str, Any]) -> None:
         record.setdefault("rank", self.rank)
+        # span context: explicit attrs > thread-local context() >
+        # process default (the run-scoped trace id) — setdefault keeps
+        # the precedence without ever overwriting a caller's tag
+        ctx = getattr(_local, "ctx", None)
+        if ctx is not None:
+            if ctx[0] is not None:
+                record.setdefault("trace", ctx[0])
+            if ctx[1] is not None:
+                record.setdefault("parent_span", ctx[1])
+        elif _default_trace is not None:
+            record.setdefault("trace", _default_trace)
         line = json.dumps(record, default=str)
         with self._mu:
             self._buf.append(line)
@@ -207,12 +298,15 @@ def enabled() -> bool:
 
 
 def disable() -> None:
-    """Close and uninstall the global tracer (tests)."""
+    """Close and uninstall the global tracer (tests).  Also clears the
+    process default trace id so one test's run id never leaks into the
+    next run's records."""
     global _tracer
     with _lock:
         if _tracer is not None:
             _tracer.close()
         _tracer = None
+    set_default_trace(None)
 
 
 def span(name: str, **attrs):

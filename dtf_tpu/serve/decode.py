@@ -43,11 +43,14 @@ Sampling: greedy when temperature == 0, else softmax sampling at
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("dtf_tpu")
 
 
 def make_decode_model(model, kv_page_size=None, kv_pool_pages=None,
@@ -145,10 +148,17 @@ class Decoder:
 
     def __init__(self, model, params, *, num_slots: int, max_seq_len: int,
                  kv_page_size: Optional[int] = None,
-                 kv_pool_pages: Optional[int] = None, mesh=None):
+                 kv_pool_pages: Optional[int] = None, mesh=None,
+                 ledger=None):
         from dtf_tpu.runtime.mesh import MODEL_AXIS
 
         self.mesh = mesh
+        # MFU/cost ledger (obs/ledger.py): each compiled body (decode
+        # step, prefill chunk per shape) registers its XLA flop/byte
+        # counts at compile time — pulled from the AOT executable the
+        # decoder then RUNS, so nothing compiles twice
+        self.ledger = ledger
+        self._execs = {}
         self.tp = int(mesh.shape[MODEL_AXIS]) if mesh is not None else 1
         self._model_axis = MODEL_AXIS if self.tp > 1 else None
         self.num_slots = int(num_slots)
@@ -297,6 +307,32 @@ class Decoder:
         return self._copy_page(cache, jnp.asarray(src, jnp.int32),
                                jnp.asarray(dst, jnp.int32))
 
+    @property
+    def compiled_count(self) -> int:
+        """How many decode/chunk executables exist so far — the engine
+        compares it across a call to tell 'this call compiled' (whose
+        wall time is compile, not compute: the MFU ledger must not
+        average it in)."""
+        return len(self._execs)
+
+    def _aot(self, name: str, jitfn, args: tuple):
+        """AOT-compile ``jitfn`` at these example args (statics
+        included, in position) and register the executable's XLA cost
+        with the ledger.  Returns the compiled callable — which takes
+        only the DYNAMIC args — or None when AOT lowering is
+        unavailable on this backend (the caller keeps the plain jit
+        path; the ledger entry is simply absent)."""
+        try:
+            compiled = jitfn.lower(*args).compile()
+        except Exception as e:  # noqa: BLE001 — observability must
+            # never take down the decode path it measures
+            log.debug("decoder: AOT compile failed for %s (%s) — "
+                      "falling back to the jit path", name, e)
+            return None
+        if self.ledger is not None:
+            self.ledger.register(name, compiled=compiled)
+        return compiled
+
     # -- jitted bodies -------------------------------------------------
     def _prefill_impl(self, params, cache, tokens, slot, length,
                       temperature, key):
@@ -415,12 +451,24 @@ class Decoder:
         # compile per chunk shape
         window = (None if self._kernel_attn
                   else (int(start) + chunk.shape[1]) // self.page_size)
-        return self._chunk(self.params, cache, jnp.asarray(chunk),
-                           jnp.asarray(block_row),
-                           jnp.asarray(sample_pos, jnp.int32),
-                           jnp.asarray(temperature, jnp.float32), key,
-                           jnp.asarray(int(start), jnp.int32), window,
-                           start == 0)
+        dyn = (self.params, cache, jnp.asarray(chunk),
+               jnp.asarray(block_row),
+               jnp.asarray(sample_pos, jnp.int32),
+               jnp.asarray(temperature, jnp.float32), key,
+               jnp.asarray(int(start), jnp.int32))
+        ekey = ("chunk", chunk.shape[1], window, start == 0)
+        fn = self._execs.get(ekey)
+        if fn is None:
+            # ledger name is per chunk SHAPE: gather-path window
+            # variants share it (latest compile's counts stand for the
+            # family — obs/ledger.py documents the approximation)
+            fn = self._aot(f"serve_prefill_chunk_c{chunk.shape[1]}",
+                           self._chunk, dyn + (window, start == 0))
+            if fn is None:
+                fn = (lambda *a, _w=window, _f=(start == 0):
+                      self._chunk(*a, _w, _f))
+            self._execs[ekey] = fn
+        return fn(*dyn)
 
     def decode_step(self, cache, tokens, index, temperature, key,
                     block_tables=None):
@@ -433,9 +481,14 @@ class Decoder:
         if self.paged:
             if block_tables is None:
                 raise ValueError("paged decode_step needs block_tables")
-            return self._decode(self.params, cache, tokens, index,
-                                jnp.asarray(block_tables, jnp.int32),
-                                temperature, key)
+            dyn = (self.params, cache, tokens, index,
+                   jnp.asarray(block_tables, jnp.int32), temperature, key)
+            fn = self._execs.get("decode")
+            if fn is None:
+                fn = (self._aot("serve_decode_step", self._decode, dyn)
+                      or self._decode)
+                self._execs["decode"] = fn
+            return fn(*dyn)
         return self._decode(self.params, cache, tokens, index,
                             temperature, key)
 

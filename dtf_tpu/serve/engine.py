@@ -103,6 +103,7 @@ import numpy as np
 
 from dtf_tpu import chaos
 from dtf_tpu.obs import trace
+from dtf_tpu.obs.ledger import Ledger
 from dtf_tpu.obs.registry import MetricsRegistry
 from dtf_tpu.serve.decode import Decoder
 
@@ -125,6 +126,11 @@ class ServeRequest:
     max_new_tokens: int = 32
     temperature: float = 0.0            # 0 = greedy
     eos_id: Optional[int] = None        # stop token (included in output)
+    # distributed-tracing span context: the trace id follows the
+    # request across processes (router → wire → here); trace_parent is
+    # the upstream span id the per-request records link back to
+    trace_id: Optional[str] = None
+    trace_parent: Optional[str] = None
     # filled by the engine
     id: int = -1
     submit_time: float = 0.0
@@ -146,6 +152,7 @@ class ServeResult:
     submit_time: float = 0.0
     finish_time: float = 0.0
     cancelled: bool = False
+    trace_id: Optional[str] = None      # the request's distributed-trace id
 
 
 class _Handle:
@@ -300,6 +307,18 @@ class PagePool:
             else:
                 self._ref[p] = c - 1
         return freed
+
+
+def _tctx(trace_id, parent=None) -> Dict[str, str]:
+    """Span-context attrs for a per-request trace record — empty when
+    the request carries no trace id (tracing off, or an untraced
+    caller), so untagged records stay exactly as small as before."""
+    if trace_id is None:
+        return {}
+    out = {"trace": trace_id}
+    if parent is not None:
+        out["parent_span"] = parent
+    return out
 
 
 def _page_digest(prev: str, page_tokens: np.ndarray) -> str:
@@ -461,6 +480,11 @@ class ServeEngine:
         self.max_delay_s = float(max_delay_s)
         self.queue_size = int(queue_size)
         self.paged = bool(kv_page_size)
+        # metrics registry must exist before the decoder: the MFU/cost
+        # ledger (obs/ledger.py) exports through it, and the decoder
+        # registers each compiled body's XLA flop/byte counts there
+        self.metrics = MetricsRegistry()
+        self.ledger = Ledger(self.metrics)
         if self.paged:
             self.page_size = int(kv_page_size)
             # None = default (4 pages — 64 tokens at the default page
@@ -477,7 +501,8 @@ class ServeEngine:
                 max_seq_len=self.max_seq_len,
                 kv_page_size=self.page_size,
                 kv_pool_pages=(int(kv_pool_pages) if kv_pool_pages
-                               else None), mesh=mesh)
+                               else None), mesh=mesh,
+                ledger=self.ledger)
             self.pool = PagePool(self.decoder.pool_pages)
             self.prefix_sharing = bool(prefix_sharing)
             self.registry = PrefixRegistry(self.page_size)
@@ -511,7 +536,6 @@ class ServeEngine:
         # counters, latency histogram — so benches and the benchmark
         # file logger read one API instead of scraping log lines
         self.completed: List[ServeResult] = []
-        self.metrics = MetricsRegistry()
         self._m_queue_depth = self.metrics.gauge("serve_queue_depth",
                                                  unit="requests")
         self._m_occupancy = self.metrics.gauge("serve_slot_occupancy",
@@ -609,11 +633,20 @@ class ServeEngine:
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0,
                eos_id: Optional[int] = None,
-               on_token: Optional[Callable] = None) -> _Handle:
+               on_token: Optional[Callable] = None,
+               trace_id: Optional[str] = None,
+               trace_parent: Optional[str] = None) -> _Handle:
         """Enqueue a request.  ``on_token`` is an optional per-token
         callback invoked FROM THE ENGINE THREAD as each token retires
         (keep it cheap — it sits on the decode path); the returned
-        handle's ``stream()`` is the pull-based alternative."""
+        handle's ``stream()`` is the pull-based alternative.
+
+        ``trace_id``/``trace_parent`` carry the distributed span
+        context: the router mints a trace id per client request and
+        sends it over the replica wire; a direct caller may pass its
+        own.  When tracing is on and no id arrives, the engine mints
+        one, so every request's lifecycle records (submit → admit →
+        prefill chunks → decode steps → retire) share one id."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -636,8 +669,11 @@ class ServeEngine:
                     f"{self.pool.usable_pages} usable — it could never "
                     f"be admitted; grow --kv_pool_pages or shrink the "
                     f"request")
+        if trace_id is None and trace.enabled():
+            trace_id = trace.new_trace_id()
         req = ServeRequest(prompt=prompt, max_new_tokens=int(max_new_tokens),
-                           temperature=float(temperature), eos_id=eos_id)
+                           temperature=float(temperature), eos_id=eos_id,
+                           trace_id=trace_id, trace_parent=trace_parent)
         handle = _Handle(req, on_token=on_token,
                         stream_lag_hist=self._m_stream_lag)
         with self._cond:
@@ -658,7 +694,8 @@ class ServeEngine:
                             "(retry_after=%.2fs)", retry)
                 trace.anomaly("serve_shed", reason="draining",
                               shed_total=self.shed_count,
-                              retry_after=retry)
+                              retry_after=retry,
+                              **_tctx(trace_id, trace_parent))
                 raise Backpressure(retry)
             if len(self._pending) >= self.queue_size:
                 self._m_shed.inc()
@@ -671,12 +708,18 @@ class ServeEngine:
                     retry)
                 trace.anomaly("serve_shed", pending=len(self._pending),
                               shed_total=self.shed_count,
-                              retry_after=retry)
+                              retry_after=retry,
+                              **_tctx(trace_id, trace_parent))
                 raise Backpressure(retry)
             req.id = next(self._ids)
             req.submit_time = time.time()
             self._pending.append(handle)
             self._m_queue_depth.set(len(self._pending))
+            if trace_id is not None:
+                trace.event("serve_submit", request=req.id,
+                            prompt_len=int(prompt.size),
+                            queue_depth=len(self._pending),
+                            **_tctx(trace_id, trace_parent))
             self._cond.notify_all()
         return handle
 
@@ -771,8 +814,18 @@ class ServeEngine:
             if admitted:
                 # batch formation: bind each admitted request to its
                 # slot (contiguous: full prefill here; paged: allocate +
-                # plan chunks, prefill advances below — interleaved)
-                with trace.span("serve_batch_form", admitted=len(admitted)):
+                # plan chunks, prefill advances below — interleaved).
+                # The span carries the admitted requests' trace ids so
+                # `trace_main --request` finds the batch work a request
+                # rode in (a batch span serves MANY requests — a list,
+                # not a single ambient context)
+                attrs = {"admitted": len(admitted)}
+                if trace.enabled():
+                    tids = [h.request.trace_id for _, h, _ in admitted
+                            if h.request.trace_id]
+                    if tids:
+                        attrs["traces"] = tids
+                with trace.span("serve_batch_form", **attrs):
                     for i, handle, pages in admitted:
                         self._admit(i, handle, pages)
                 self._m_admitted.inc(len(admitted))
@@ -878,6 +931,10 @@ class ServeEngine:
     def _admit(self, slot_idx: int, handle: _Handle, grant):
         req = handle.request
         req.admit_time = time.time()
+        if req.trace_id is not None:
+            trace.event("serve_admit", request=req.id, slot=slot_idx,
+                        queue_wait_s=req.admit_time - req.submit_time,
+                        **_tctx(req.trace_id, req.trace_parent))
         if not self.paged:
             self._key, sub = jax.random.split(self._key)
             tok, self._cache, _ = self.decoder.prefill(
@@ -943,8 +1000,11 @@ class ServeEngine:
         plen = int(req.prompt.size)
         sample_pos = plen - 1 - start if is_last else 0
         self._key, sub = jax.random.split(self._key)
+        t0 = time.perf_counter()
+        pre_compiled = self.decoder.compiled_count
         with trace.span("serve_prefill_chunk", slot=slot_idx, start=start,
-                        tokens=clen, last=is_last):
+                        tokens=clen, last=is_last,
+                        **_tctx(req.trace_id, req.trace_parent)):
             tok, self._cache, _ = self.decoder.prefill_chunk(
                 self._cache, slot.prompt_padded[start:start + clen],
                 slot.block_row, start, sample_pos, req.temperature, sub)
@@ -952,6 +1012,16 @@ class ServeEngine:
         slot.chunk_i += 1
         if is_last:
             first = int(tok)
+            # the int(tok) sync above makes this the one chunk whose
+            # wall time spans a real device sync — the only honest
+            # sample the MFU ledger takes for the chunk executable
+            # (earlier chunks retire asynchronously; syncing them
+            # would reintroduce the head-of-line gap chunking bounds).
+            # A call that COMPILED is dropped: its wall is XLA, not
+            # compute
+            if self.decoder.compiled_count == pre_compiled:
+                self.ledger.observe(f"serve_prefill_chunk_c{clen}",
+                                    time.perf_counter() - t0)
             req.first_token_time = time.time()
             slot.tokens = [first]
             slot.last_token = first
@@ -993,13 +1063,26 @@ class ServeEngine:
                     # their garbage goes to the scratch page
                     tables[i] = s.block_row
         self._key, sub = jax.random.split(self._key)
-        with trace.span("serve_decode"):
+        attrs = {}
+        if trace.enabled():
+            tids = [s.handle.request.trace_id for s in self._slots
+                    if s is not None and s.phase == "decode"
+                    and s.handle.request.trace_id]
+            if tids:
+                attrs["traces"] = tids
+        pre_compiled = self.decoder.compiled_count
+        with trace.span("serve_decode", **attrs):
             out, self._cache, _ = self.decoder.decode_step(
                 self._cache, tokens, index, temps, sub,
                 block_tables=tables)
             out = np.asarray(out)
         step_dt = time.perf_counter() - now
         self._m_step_time.observe(step_dt)
+        # MFU ledger: np.asarray(out) above synced the step, so this
+        # wall time is real device time, not async dispatch; the step
+        # that COMPILED is dropped (its wall is XLA, not compute)
+        if self.decoder.compiled_count == pre_compiled:
+            self.ledger.observe("serve_decode_step", step_dt)
         # chaos slow_replica@replica<K>:<F>: stretch each decode step to
         # F× its measured time — the straggler-replica signature the
         # router's deadline + least-loaded placement must absorb.  A
@@ -1051,7 +1134,13 @@ class ServeEngine:
             queue_wait_s=req.admit_time - req.submit_time,
             time_to_first_token_s=req.first_token_time - req.submit_time,
             latency_s=req.finish_time - req.submit_time,
-            submit_time=req.submit_time, finish_time=req.finish_time)
+            submit_time=req.submit_time, finish_time=req.finish_time,
+            trace_id=req.trace_id)
+        if req.trace_id is not None:
+            trace.event("serve_retire", request=req.id,
+                        tokens=len(slot.tokens),
+                        latency_s=result.latency_s,
+                        **_tctx(req.trace_id, req.trace_parent))
         self._ewma_latency = (0.8 * self._ewma_latency
                               + 0.2 * result.latency_s)
         self._m_completed.inc()
@@ -1098,6 +1187,9 @@ class ServeEngine:
             self._stop.set()
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
+        # MFU/cost summary into the trace stream (`trace_main --ledger`
+        # reads these; the gauges stay live on engine.metrics)
+        self.ledger.emit_summary()
 
     def __enter__(self):
         return self

@@ -8,7 +8,17 @@ socket:
 
   router → replica
     {"op":"submit","id":W,"prompt":[...],"max_new_tokens":N,
-     "temperature":T,"eos_id":E}        dispatch one request
+     "temperature":T,"eos_id":E,
+     "trace":TID,"pspan":SID}           dispatch one request; "trace"
+                                        is the router-minted
+                                        distributed-trace id and
+                                        "pspan" the router-side
+                                        request span id — the engine
+                                        tags every per-request record
+                                        with them, so one request's
+                                        life is reconstructable across
+                                        processes (trace_main
+                                        --request TID)
     {"op":"drain"}                      stop admissions, finish in-flight
     {"op":"stats"}                      request a stats snapshot
 
@@ -80,10 +90,11 @@ class ReplicaServer:
     """Serve one engine over a loopback socket + announce file.
 
     ``engine`` needs ``submit(prompt, max_new_tokens, temperature,
-    eos_id, on_token) -> handle`` (handle: ``result(timeout)`` →
-    object with ``.tokens``/``.cancelled``), ``begin_drain()`` and
-    ``outstanding``; :class:`~dtf_tpu.serve.engine.ServeEngine`
-    satisfies it, and the router tests use a jax-free fake."""
+    eos_id, on_token, trace_id, trace_parent) -> handle`` (handle:
+    ``result(timeout)`` → object with ``.tokens``/``.cancelled``),
+    ``begin_drain()`` and ``outstanding``;
+    :class:`~dtf_tpu.serve.engine.ServeEngine` satisfies it, and the
+    router tests use a jax-free fake."""
 
     def __init__(self, engine, replica_id: int, rendezvous_dir: str,
                  host: str = "127.0.0.1", port: int = 0,
@@ -256,7 +267,14 @@ class ReplicaServer:
                 max_new_tokens=int(msg.get("max_new_tokens", 32)),
                 temperature=float(msg.get("temperature", 0.0)),
                 eos_id=msg.get("eos_id"),
-                on_token=on_token)
+                on_token=on_token,
+                # distributed span context: the router's trace id and
+                # request span id ride the wire so this replica's
+                # records join the request's cross-process timeline —
+                # including a failover replay, which arrives with the
+                # SAME trace id on a sibling
+                trace_id=msg.get("trace"),
+                trace_parent=msg.get("pspan"))
         except Backpressure as bp:
             outq.put({"op": "backpressure", "id": wire_id,
                       "retry_after": float(bp.retry_after)})

@@ -111,6 +111,10 @@ class RouterResult:
                                  # requests only; greedy never)
     submit_time: float = 0.0
     finish_time: float = 0.0
+    # the router-minted distributed-trace id: every record this request
+    # produced — router events, replica spans, failover replays —
+    # carries it; `trace_main --request <id>` renders the timeline
+    trace_id: Optional[str] = None
 
 
 class RouterHandle:
@@ -175,12 +179,18 @@ class _Request:
                  "eos_id", "deadline", "deadline_s", "digests", "handle",
                  "delivered", "attempt", "next_try", "active",
                  "bp_replicas", "redispatches", "diverged", "done",
-                 "submit_time", "last_dispatch", "last_progress")
+                 "submit_time", "last_dispatch", "last_progress",
+                 "trace", "span")
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, eos_id, deadline_s: float,
-                 digests: List[str]):
+                 digests: List[str], trace_id: Optional[str] = None):
         self.id = rid
+        # distributed span context: one trace id for the request's
+        # whole cross-process life, one router-side span id the
+        # replica-side records link back to (parent_span)
+        self.trace = trace_id or trace.new_trace_id()
+        self.span = trace.new_span_id()
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
@@ -429,7 +439,8 @@ class Router:
 
     def submit(self, prompt, max_new_tokens: int = 32,
                temperature: float = 0.0, eos_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> RouterHandle:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RouterHandle:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -437,6 +448,10 @@ class Router:
                            else self.deadline_s)
         if deadline_s <= 0:
             raise ValueError(f"deadline must be positive, got {deadline_s}")
+        # the request's distributed-trace id is minted HERE (or carried
+        # in from an upstream caller) — before admission, so even a
+        # shed is attributable to the request that suffered it
+        trace_id = trace_id or trace.new_trace_id()
         digests = self._digest_chain(prompt)
         with self._mu:
             if self._stopping:
@@ -453,15 +468,20 @@ class Router:
                           retry)
                 trace.anomaly("router_shed", reason=reason,
                               outstanding=self._outstanding,
-                              retry_after=retry)
+                              retry_after=retry, trace=trace_id)
                 raise Backpressure(retry)
             self._ids += 1
             req = _Request(self._ids, prompt, int(max_new_tokens),
-                           float(temperature), eos_id, deadline_s, digests)
+                           float(temperature), eos_id, deadline_s, digests,
+                           trace_id=trace_id)
             self._queue.append(req)
             self._live[req.id] = req
             self._outstanding += 1
             self._m_queue_depth.set(len(self._queue))
+            trace.event("router_submit", request=req.id, trace=req.trace,
+                        span_id=req.span, prompt_len=int(prompt.size),
+                        deadline_s=deadline_s,
+                        queue_depth=len(self._queue))
             self._mu.notify_all()
         return req.handle
 
@@ -540,7 +560,7 @@ class Router:
                 continue
             self._m_deadline.inc()
             trace.anomaly("router_deadline", request=req.id,
-                          deadline_s=req.deadline_s,
+                          trace=req.trace, deadline_s=req.deadline_s,
                           delivered=len(req.delivered),
                           redispatches=req.redispatches)
             self._resolve_locked(
@@ -567,7 +587,7 @@ class Router:
             return
         self._m_bp_relayed.inc()
         trace.anomaly("router_shed", reason="all_replicas_saturated",
-                      request=req.id, retry_after=retry)
+                      request=req.id, trace=req.trace, retry_after=retry)
         self._resolve_locked(req, exc=Backpressure(retry))
 
     def _dispatch_locked(self, req: _Request, rep: _Replica) -> None:
@@ -579,15 +599,22 @@ class Router:
         seq = self._dispatch_seq
         self._dispatch_seq += 1
         self._m_dispatch.inc()
+        # span context rides the wire: the replica tags its per-request
+        # records with the SAME trace id (attempt 2 after a failover
+        # included — the replay keeps the request's identity)
         msg = {"op": "submit", "id": wire_id,
                "prompt": [int(t) for t in req.prompt],
                "max_new_tokens": req.max_new_tokens,
-               "temperature": req.temperature, "eos_id": req.eos_id}
+               "temperature": req.temperature, "eos_id": req.eos_id,
+               "trace": req.trace, "pspan": req.span}
         try:
             send_msg(rep.wfile, rep.wlock, msg)
         except (OSError, ValueError, AttributeError):
             self._replica_down_locked(rep, "send_failed")
             return
+        trace.event("router_dispatch", request=req.id, trace=req.trace,
+                    span_id=req.span, replica=rep.id,
+                    attempt=req.attempt)
         # prefix ownership: this replica's registry will hold these
         # pages once the prefill completes — route siblings here
         for digest in req.digests:
@@ -614,7 +641,7 @@ class Router:
                 continue
             rep = min(eligible, key=lambda r: (len(r.inflight), r.id))
             self._m_hedge.inc()
-            trace.event("router_hedge", request=req.id,
+            trace.event("router_hedge", request=req.id, trace=req.trace,
                         slow_replica=current, hedge_replica=rep.id)
             self._dispatch_locked(req, rep)
 
@@ -667,9 +694,16 @@ class Router:
                         req.diverged = True
                         self._m_diverged.inc()
                         trace.anomaly("redispatch_divergence",
-                                      request=req.id, index=i,
+                                      request=req.id, trace=req.trace,
+                                      index=i,
                                       expected=req.delivered[i], got=tok)
                 elif i == len(req.delivered):
+                    if not req.delivered:
+                        # once per request across failovers (a replay's
+                        # token 0 lands in the verify branch above):
+                        # the stream-delivery milestone of the timeline
+                        trace.event("router_first_token", request=req.id,
+                                    trace=req.trace, replica=rep.id)
                     req.delivered.append(tok)
                     req.last_progress = time.monotonic()
                     req.handle._emit(tok)
@@ -690,7 +724,8 @@ class Router:
                         and not req.diverged):
                     req.diverged = True
                     self._m_diverged.inc()
-                    trace.anomaly("redispatch_divergence", request=req.id)
+                    trace.anomaly("redispatch_divergence", request=req.id,
+                                  trace=req.trace)
                 rep.completed += 1
                 finish = time.time()
                 latency = finish - req.submit_time
@@ -698,12 +733,17 @@ class Router:
                                       + 0.2 * latency)
                 self._m_completed.inc()
                 self._m_latency.observe(latency)
+                trace.event("router_complete", request=req.id,
+                            trace=req.trace, span_id=req.span,
+                            replica=rep.id, tokens=len(tokens),
+                            redispatches=req.redispatches,
+                            latency_s=latency)
                 self._resolve_locked(req, result=RouterResult(
                     request_id=req.id, tokens=tokens,
                     prompt_len=int(req.prompt.size), latency_s=latency,
                     replica=rep.id, redispatches=req.redispatches,
                     diverged=req.diverged, submit_time=req.submit_time,
-                    finish_time=finish))
+                    finish_time=finish, trace_id=req.trace))
             elif op == "backpressure":
                 rep.inflight.pop(wire_id, None)
                 req.active.pop(wire_id, None)
@@ -731,6 +771,11 @@ class Router:
                 self.max_retry_backoff_s)
         else:
             req.next_try = 0.0
+        # the failover leg of the request timeline: same trace id, next
+        # dispatch will carry attempt N+1
+        trace.event("router_requeue", request=req.id, trace=req.trace,
+                    reason=reason, redispatches=req.redispatches,
+                    delivered=len(req.delivered))
         if req not in self._queue:
             self._queue.append(req)
         self._mu.notify_all()
@@ -836,8 +881,11 @@ class Router:
             log.error("router: replica %d lost (%s) — %d in-flight "
                       "request(s) re-dispatched", rep.id, reason,
                       len(stranded))
+            # the stranded requests' trace ids make the loss part of
+            # each request's timeline, not just the replica's
             trace.anomaly("replica_lost", replica=rep.id, reason=reason,
-                          redispatched=len(stranded))
+                          redispatched=len(stranded),
+                          traces=[r.trace for r in stranded])
 
     def _probe_loop(self) -> None:
         while not self._stopping:
@@ -924,6 +972,20 @@ class Router:
             self._mu.notify_all()
 
     # -- introspection -------------------------------------------------
+    def health(self) -> dict:
+        """The /healthz payload (obs/prom.py MetricsServer health_fn):
+        ``ok`` while the router can still place work — at least one
+        replica healthy and not draining/stopping."""
+        with self._mu:
+            healthy = [r.healthy for r in self._replicas]
+            return {
+                "ok": any(healthy) and not self._stopping
+                      and not self._draining,
+                "draining": self._draining,
+                "replicas_healthy": healthy,
+                "outstanding": self._outstanding,
+            }
+
     def replica_healthy(self, replica_id: int) -> bool:
         with self._mu:
             return self._replicas[replica_id].healthy
@@ -960,14 +1022,18 @@ class Router:
 def replica_spawner(cmd: List[str], rendezvous_dir: str,
                     log_dir: Optional[str] = None,
                     env_extra: Optional[dict] = None,
-                    cwd: Optional[str] = None) -> Callable:
+                    cwd: Optional[str] = None,
+                    extra_flags: Optional[Callable] = None) -> Callable:
     """Standard spawn callable for :class:`Router`: runs ``cmd`` with
     the replica-tier environment contract — DTF_PROCESS_ID = replica
     id (announce/heartbeat/trace rank identity), DTF_HEARTBEAT_DIR =
     the rendezvous dir, DTF_RESTART_GENERATION = respawn generation
     (the PR-4/PR-5 restart-tagging contract) — logging each replica to
     ``replica{K}.log`` (``.retry{G}`` suffixed on respawn, keeping the
-    first failure's log like the launcher does)."""
+    first failure's log like the launcher does).  ``extra_flags``
+    (``replica_id -> [flag, ...]``) appends PER-REPLICA flags — the
+    metrics-port fan-out (router_main gives replica K port base+1+K so
+    one ``--metrics_port`` makes the whole tier scrapable)."""
     rendezvous_dir = os.path.abspath(rendezvous_dir)
     log_dir = os.path.abspath(log_dir or rendezvous_dir)
     # the replica must import dtf_tpu no matter where the ROUTER was
@@ -991,10 +1057,11 @@ def replica_spawner(cmd: List[str], rendezvous_dir: str,
         suffix = f".retry{generation}" if generation else ""
         logf = open(os.path.join(
             log_dir, f"replica{replica_id}{suffix}.log"), "wb")
+        full = cmd + ["--replica_id", str(replica_id)]
+        if extra_flags is not None:
+            full += [str(f) for f in (extra_flags(replica_id) or [])]
         try:
-            return subprocess.Popen(cmd + ["--replica_id",
-                                           str(replica_id)],
-                                    env=env, cwd=cwd, stdout=logf,
+            return subprocess.Popen(full, env=env, cwd=cwd, stdout=logf,
                                     stderr=subprocess.STDOUT)
         finally:
             logf.close()

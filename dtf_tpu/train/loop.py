@@ -24,6 +24,7 @@ state buffers are donated so updates are in-place in HBM.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Iterator, Optional
@@ -754,6 +755,23 @@ class Trainer:
         self.eval_step = jax.jit(eval_sharded)
 
     # ------------------------------------------------------------------
+    def _compile_with_ledger(self, ledger, state, sharded):
+        """AOT-compile the train step and register its XLA flop/byte
+        counts with the MFU ledger.  Returns the compiled executable —
+        the SAME program the jit path would run (donation included), so
+        cost analysis is free rather than a second compile.  Any
+        failure degrades to the plain jit path with no registration:
+        observability must never change whether a run trains."""
+        try:
+            compiled = self.train_step.lower(state, *sharded).compile()
+        except Exception as e:  # noqa: BLE001 — see docstring
+            log.debug("ledger: train-step AOT compile unavailable (%s) "
+                      "— using the jit path, no MFU entry", e)
+            return self.train_step
+        ledger.register("train_step", compiled=compiled)
+        return compiled
+
+    # ------------------------------------------------------------------
     def evaluate(self, state: TrainState, eval_iter: Iterator,
                  heartbeat=None):
         """Weighted-exact eval: batches are (images, labels[, mask]);
@@ -832,6 +850,23 @@ class Trainer:
         # start step already passed profile_range[0] must still trace the
         # remaining in-range steps (--profile_steps contract under --resume).
         profile_started = False
+        # profiler output goes to the TRACE dir when one is configured
+        # — the XLA dump is observability artifact, not run state, and
+        # mixing it into model_dir buries checkpoints under trace
+        # protos (model_dir stays the fallback for untraced runs)
+        profile_dir = (getattr(cfg, "trace_dir", "")
+                       or os.environ.get("DTF_TRACE_DIR", "")
+                       or cfg.model_dir)
+        # MFU/cost ledger (obs/ledger.py): the train step registers its
+        # XLA flop/byte counts at compile time — from the AOT
+        # lower().compile() executable the loop then RUNS (no second
+        # compile) — and every clean log window feeds its synced
+        # per-step wall time.  DTF_LEDGER=0 is the kill switch (and
+        # restores the pre-AOT jit dispatch path wholesale).
+        from dtf_tpu.obs.ledger import Ledger
+        ledger = Ledger()
+        ledger_on = os.environ.get("DTF_LEDGER", "1") != "0"
+        step_fn = self.train_step
 
         for cb in callbacks:
             _call(cb, "on_train_begin", None)
@@ -862,7 +897,12 @@ class Trainer:
                     if (profile_range and not profile_started
                             and global_step >= profile_range[0]
                             and global_step <= profile_range[1]):
-                        jax.profiler.start_trace(cfg.model_dir)
+                        jax.profiler.start_trace(profile_dir)
+                        # surfaced by trace_main's summary: where the
+                        # profiler dump for this run actually lives
+                        trace.event("profiler_trace", path=profile_dir,
+                                    start_step=global_step,
+                                    stop_step=profile_range[1])
                         profiling = True
                         profile_started = True
                     images, labels = next(train_iter)
@@ -880,11 +920,14 @@ class Trainer:
                     if compile_pending:
                         compile_pending = False
                         with trace.span("compile", step=global_step):
+                            if ledger_on:
+                                step_fn = self._compile_with_ledger(
+                                    ledger, state, sharded)
                             with trace.span("step", step=global_step):
-                                state, metrics = self.train_step(state, *sharded)
+                                state, metrics = step_fn(state, *sharded)
                     else:
                         with trace.span("step", step=global_step):
-                            state, metrics = self.train_step(state, *sharded)
+                            state, metrics = step_fn(state, *sharded)
                     global_step += 1
                     if global_step % cfg.log_steps == 0:
                         # device_get (host copy): block_until_ready can
@@ -908,6 +951,10 @@ class Trainer:
                                 steps=cfg.log_steps,
                                 step_s=window_s / cfg.log_steps)
                             window_step_s.append(window_s / cfg.log_steps)
+                            # MFU ledger: the one per-step duration that
+                            # spans a real device sync
+                            ledger.observe("train_step",
+                                           window_s / cfg.log_steps)
                             if step_guard is not None:
                                 step_guard.observe(global_step, window_s)
                         window_t0 = now
@@ -1021,6 +1068,7 @@ class Trainer:
                  time.time() - t0, global_step)
         trace.event("train_end", step=global_step,
                     wall_s=time.time() - t0)
+        ledger.emit_summary()
         trace.flush()
         # calibration gauges (dtf_tpu/plan reads these after a measured
         # smoke): the median clean-window step time, and the live

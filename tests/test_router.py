@@ -75,6 +75,10 @@ class FakeEngine:
         self._mu = threading.Lock()
         self.draining = False
         self.dead = False
+        # (trace_id, trace_parent) per submit, in order — the
+        # propagation tests assert the router's span context crossed
+        # the real wire intact (failover replay included)
+        self.trace_ids = []
 
     @property
     def outstanding(self):
@@ -84,8 +88,10 @@ class FakeEngine:
         self.draining = True
 
     def submit(self, prompt, max_new_tokens=32, temperature=0.0,
-               eos_id=None, on_token=None):
+               eos_id=None, on_token=None, trace_id=None,
+               trace_parent=None):
         with self._mu:
+            self.trace_ids.append((trace_id, trace_parent))
             if self.draining or self._n >= self.queue_limit:
                 raise Backpressure(0.3)
             self._n += 1
@@ -478,6 +484,89 @@ def test_router_replica_stats_roundtrip(tmp_path):
         assert "outstanding" in stats
     finally:
         stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# request-scoped distributed tracing over the wire
+# ---------------------------------------------------------------------------
+
+def test_trace_id_propagates_over_wire_and_failover(tmp_path):
+    """The router mints one trace id per request, ships it over the
+    REAL replica wire, and a failover's re-dispatch ships the SAME id
+    to the sibling — so one request's whole cross-process life shares
+    one id.  Token dedup across the replay is preserved (the client
+    stream sees every token once), and the router's trace stream
+    records the full lifecycle: submit → dispatch(attempt 1) →
+    replica_lost/requeue → dispatch(attempt 2) → complete."""
+    tdir = tmp_path / "trace"
+    os.makedirs(tdir, exist_ok=True)
+    trace.configure(str(tdir), stream="router")
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.02))
+    try:
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 97, (6,)).astype(np.int32)
+                   for _ in range(4)]
+        handles = [router.submit(p, max_new_tokens=25) for p in prompts]
+        streams = [[] for _ in handles]
+        threads = [threading.Thread(
+            target=lambda h=h, out=out: out.extend(h.stream(timeout=30)),
+            daemon=True) for h, out in zip(handles, streams)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        reps[0].kill()
+        results = [h.result(timeout=30) for h in handles]
+        for t in threads:
+            t.join(timeout=30)
+        # every result exposes its trace id; all distinct
+        tids = [r.trace_id for r in results]
+        assert all(tids) and len(set(tids)) == len(tids)
+        # an explicit caller-provided id round-trips
+        h = router.submit(prompts[0], max_new_tokens=3,
+                          trace_id="caller-tid")
+        assert h.result(timeout=30).trace_id == "caller-tid"
+        # the wire carried each id verbatim to the engines
+        seen = [t for rep in reps for (t, _) in rep.engine.trace_ids]
+        for tid in tids:
+            assert tid in seen
+        # a failed-over request's id reached BOTH replicas, replay
+        # deduped (stream == result tokens == oracle, exactly once)
+        victims = [(r, s, p) for r, s, p in
+                   zip(results, streams, prompts) if r.redispatches]
+        assert victims, "the kill should have stranded work"
+        for r, s, p in victims:
+            per_rep = [[t for (t, _) in rep.engine.trace_ids]
+                       for rep in reps]
+            assert all(r.trace_id in ts for ts in per_rep), (
+                "the replayed request's trace id did not reach both "
+                "replicas")
+            want = oracle(p, 25)
+            assert r.tokens == want and s == want
+        # router-side lifecycle records, all under the one trace id
+        trace.flush()
+        recs = trace.read_records(str(tdir / "trace_router.jsonl"))
+        victim = victims[0][0]
+        mine = [r for r in recs if r.get("trace") == victim.trace_id]
+        names = [r["name"] for r in mine]
+        for needed in ("router_submit", "router_dispatch",
+                       "router_requeue", "router_complete"):
+            assert needed in names, f"missing {needed}: {names}"
+        attempts = [r["attempt"] for r in mine
+                    if r["name"] == "router_dispatch"]
+        assert max(attempts) >= 2, "failover re-dispatch not recorded"
+        # replica_lost carries the stranded requests' trace ids
+        lost = [r for r in recs if r.get("name") == "replica_lost"]
+        assert lost and victim.trace_id in lost[0].get("traces", [])
+        # parent_span on the wire: the engines saw the router span id
+        subs = [r for r in mine if r["name"] == "router_submit"]
+        span = subs[0]["span_id"]
+        parents = [pp for rep in reps
+                   for (t, pp) in rep.engine.trace_ids
+                   if t == victim.trace_id]
+        assert parents and all(pp == span for pp in parents)
+    finally:
+        stop_tier(router, reps)
+        trace.disable()
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,13 @@ Five stages, every assertion fatal (nonzero exit):
      router failed over, the respawned replica (PR-4 budget machinery)
      re-registers AND takes traffic, and `trace_main --check --allow
      injected_fault --allow replica_lost` is green — the injected
-     fault and the router's reaction, nothing else.
+     fault and the router's reaction, nothing else.  ADDITIONALLY the
+     distributed-tracing bar: `trace_main --request <id>` on a killed
+     (failed-over) request's trace id reconstructs its FULL
+     cross-process timeline — router submit/dispatch, replica-side
+     prefill/decode work, the failover re-dispatch (attempt 2), and
+     completion — with every record carrying that one trace id and
+     records from BOTH the router stream and replica rank files.
   3. net_partition@replica1:T — the router's health probes of replica
      1 are dropped long enough to out-silence the health timeout (the
      router sees timeouts, NOT a clean exit: the process never dies).
@@ -137,6 +143,52 @@ def check_trace(trace_dir, allow=("injected_fault", "replica_lost")):
             f"run contained unexpected anomalies")
 
 
+def check_request_timeline(trace_dir, trace_id):
+    """`trace_main --request <id>` must reconstruct the request's
+    cross-process life: records from router AND replica ranks, the
+    failover re-dispatch (attempt 2), replica-side decode work, and
+    completion — every record carrying the one trace id (the filter
+    guarantees membership; we assert the story is complete)."""
+    cmd = [sys.executable, "-m", "dtf_tpu.cli.trace_main", trace_dir,
+           "--merge", "--request", trace_id]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          cwd=REPO, timeout=120)
+    if proc.returncode != 0:
+        print(proc.stderr[-2000:], file=sys.stderr)
+        raise SystemExit(f"trace_main --request {trace_id} exited "
+                         f"{proc.returncode}")
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines() if ln]
+    for r in recs:
+        tagged = (r.get("trace") == trace_id
+                  or trace_id in (r.get("traces") or ()))
+        if not tagged:
+            raise SystemExit(f"--request returned a record without the "
+                             f"trace id: {r}")
+    ranks = {str(r.get("rank")) for r in recs}
+    names = [r.get("name") for r in recs]
+    attempts = [r.get("attempt") for r in recs
+                if r.get("name") == "router_dispatch"]
+    problems = []
+    if "router" not in ranks or len(ranks) < 2:
+        problems.append(f"records span ranks {sorted(ranks)} — need "
+                        f"the router stream AND replica rank(s)")
+    for needed in ("router_submit", "router_dispatch",
+                   "router_complete", "serve_submit", "serve_retire"):
+        if needed not in names:
+            problems.append(f"missing {needed} in the timeline")
+    if not any(n in ("serve_decode", "serve_prefill_chunk")
+               for n in names):
+        problems.append("no replica-side decode/prefill work records")
+    if not attempts or max(attempts) < 2:
+        problems.append(f"no failover re-dispatch recorded "
+                        f"(attempts={attempts})")
+    if problems:
+        print(proc.stdout[-3000:], file=sys.stderr)
+        raise SystemExit("request-timeline reconstruction FAILED: "
+                         + "; ".join(problems))
+    return len(recs), sorted(ranks)
+
+
 def assert_exact(tokens, baseline, stage):
     for i, (got, want) in enumerate(zip(tokens, baseline)):
         if isinstance(got, Exception):
@@ -215,11 +267,23 @@ def main() -> int:
         raise SystemExit(
             f"replica_kill: respawned replica re-registered but took no "
             f"traffic ({before} -> {after})")
+    # the distributed-tracing acceptance bar: pick a request the kill
+    # actually stranded (redispatches >= 1) and reconstruct its whole
+    # cross-process life from its trace id
+    killed = [r for r in results if r.redispatches >= 1]
+    if not killed:
+        raise SystemExit("replica_kill: no request recorded a "
+                         "re-dispatch — the kill stranded nothing?")
+    victim = killed[0]
     teardown(router, tdir)
     chaos.disable()
     check_trace(tdir)
+    n_recs, t_ranks = check_request_timeline(tdir, victim.trace_id)
     print(f"  kill OK: token-exact, 0 lost, failovers={failovers}, "
-          f"respawns={respawns}, post-respawn spread {after}")
+          f"respawns={respawns}, post-respawn spread {after}; "
+          f"request {victim.request_id} timeline reconstructed from "
+          f"trace {victim.trace_id} ({n_recs} records across ranks "
+          f"{t_ranks})")
 
     # -- 3. net partition ------------------------------------------------
     print("router smoke [3/5]: net_partition@replica1 (probe drops, "
