@@ -43,7 +43,11 @@ Prints ONE JSON line per metric, bench.py contract ({"metric", "value",
 
 --out writes every metric line into ONE BenchmarkMetric JSON artifact
 (BENCH_serve_rNN.json shape) so the serving perf trajectory is tracked
-across PRs like training's BENCH_r0N.json files.
+across PRs like training's BENCH_r0N.json files.  The artifact carries
+the MFU/cost-ledger gauges for the decode-step executable
+(serve_ledger_decode_* — wall, achieved TFLOP/s, MFU/HBM fraction when
+the chip's peaks are known), so tools/bench_gate.py gates serve
+EFFICIENCY across PRs, not just throughput bars.
 
 Run: python bench_serve.py [--model transformer_small] [--batch 8]
      [--steps 64] [--seq 256] [--router_replicas 2] [--out FILE]
@@ -594,6 +598,22 @@ def main():
            p90=round(occ["p90"], 4), samples=occ["count"])
     _jline("serve_queue_depth_p90", qd["p90"], "requests",
            max=qd["max"], mean=round(qd["mean"], 4))
+    # MFU/cost ledger gauges for the decode-step executable: the --out
+    # artifact then carries serve EFFICIENCY, not just throughput, so
+    # tools/bench_gate.py gates achieved-TFLOP/s (and MFU/HBM fraction
+    # where the chip's peaks are known) across PRs
+    led = eng.ledger.summary().get("serve_decode_step")
+    if led and led["count"]:
+        _jline("serve_ledger_decode_step_wall_ms", led["mean_s"] * 1e3,
+               "ms", calls=led["count"], batch=args.batch)
+        _jline("serve_ledger_decode_achieved_tflops",
+               led["achieved_tflops"], "tflops",
+               gflops_per_step=round(led["flops"] / 1e9, 3))
+        if led["mfu"] is not None:
+            _jline("serve_ledger_decode_mfu", led["mfu"], "mfu")
+        if led["hbm_frac"] is not None:
+            _jline("serve_ledger_decode_hbm_frac", led["hbm_frac"],
+                   "fraction")
 
     # mixed-length scenario: paged (50% pool, chunked / un-chunked)
     # vs contiguous — the long-context serving acceptance numbers

@@ -24,7 +24,10 @@ record.
 ``--ledger`` renders the MFU/cost ledger (obs/ledger.py) from the
 trace stream's ``ledger_exec``/``ledger_summary`` events: one row per
 (rank, executable) with XLA FLOPs/bytes, measured mean wall time,
-achieved TFLOP/s, MFU, and HBM-bandwidth fraction.
+achieved TFLOP/s, MFU, and HBM-bandwidth fraction.  With ``--json``
+the same rows come out as one JSON object (``{"ledger": [...]}``) —
+the machine-readable join the capacity simulator's calibration
+(``plan_serve_main``) consumes instead of scraping the table.
 
 ``--merge`` emits ONE time-ordered cross-rank stream (JSONL on stdout)
 instead of the aggregate table: every record from every
@@ -184,11 +187,14 @@ def print_request_timeline(trace_id: str, recs: List[dict]) -> None:
               f"{tag}{name}{dur} {detail if detail else ''}")
 
 
-def print_ledger(merged: List[dict]) -> bool:
-    """The MFU/cost ledger table from ledger_exec/ledger_summary
-    events — latest record per (rank, executable) wins (a re-compile
-    or a later summary supersedes).  Returns False when the stream
-    carries no ledger records at all."""
+def ledger_rows(merged: List[dict]) -> List[dict]:
+    """The MFU/cost ledger as machine-readable rows from
+    ledger_exec/ledger_summary events — latest record per (rank,
+    executable) wins (a re-compile or a later summary supersedes).
+    One dict per (rank, exec): flops/bytes/count/mean_s/
+    achieved_tflops/mfu/hbm_frac (missing fields None).  This is the
+    join surface the capacity simulator's calibration reads — the
+    human table in :func:`print_ledger` renders the same rows."""
     rows: Dict[tuple, dict] = {}
     for rec in merged:
         if rec.get("name") == "ledger_exec":
@@ -201,6 +207,14 @@ def print_ledger(merged: List[dict]) -> bool:
                 count=rec.get("count"), mean_s=rec.get("mean_s"),
                 achieved_tflops=rec.get("achieved_tflops"),
                 mfu=rec.get("mfu"), hbm_frac=rec.get("hbm_frac"))
+    return [{"rank": rank, "exec": name, **r}
+            for (rank, name), r in sorted(rows.items())]
+
+
+def print_ledger(merged: List[dict]) -> bool:
+    """Render :func:`ledger_rows` as the human table.  Returns False
+    when the stream carries no ledger records at all."""
+    rows = ledger_rows(merged)
     if not rows:
         return False
 
@@ -211,8 +225,8 @@ def print_ledger(merged: List[dict]) -> bool:
            f"{'mean_ms':>9}{'tflop/s':>9}{'mfu':>7}{'hbm':>7}")
     print(hdr)
     print("-" * len(hdr))
-    for (rank, name), r in sorted(rows.items()):
-        print(f"{rank:<7}{name:<28}"
+    for r in rows:
+        print(f"{r['rank']:<7}{r['exec']:<28}"
               f"{fmt((r.get('flops') or 0) / 1e9, '9.1f'):>9}"
               f"{fmt(r.get('count'), 'd'):>7}"
               f"{fmt((r.get('mean_s') or 0) * 1e3, '9.2f'):>9}"
@@ -352,7 +366,15 @@ def main(argv=None) -> int:
         anomalies = [r for r in merged if r.get("kind") == "anomaly"]
     elif args.ledger:
         merged = merge_records(files)
-        if not print_ledger(merged):
+        if args.json:
+            # machine-readable join surface (the capacity simulator's
+            # calibration consumes this instead of scraping the table)
+            rows = ledger_rows(merged)
+            if not rows:
+                print("no ledger records in this trace", file=sys.stderr)
+                return 2
+            print(json.dumps({"ledger": rows}, indent=2, default=str))
+        elif not print_ledger(merged):
             print("no ledger records in this trace (ledger_exec/"
                   "ledger_summary events are emitted by instrumented "
                   "train/serve runs)", file=sys.stderr)

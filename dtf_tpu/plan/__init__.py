@@ -27,8 +27,19 @@ Layers:
   compile     — Plan ↔ the existing config flags (`--plan auto|<file>`);
                 a plan-selected run is bit-identical to the same flags
                 set by hand (test-asserted)
+  serve_trace — serving WORKLOADS: per-request reconstruction from
+                recorded router/replica traces, synthetic Poisson/
+                burst/shared-prefix arrival generators
+  serve_model — the serving-capacity simulator: replay a workload
+                through an analytic fleet model (TP × replicas × page
+                pool × chunking × deadlines) and answer what-ifs —
+                replicas for X req/s at a p99 SLO, TP-vs-replicas at
+                fixed chips, pool size vs shed rate — calibrated
+                against measured runs like the training planner
 
-CLI: ``python -m dtf_tpu.cli.plan_main`` (rank / --check / --calibrate).
+CLIs: ``python -m dtf_tpu.cli.plan_main`` (rank / --check /
+--calibrate) and ``python -m dtf_tpu.cli.plan_serve_main`` (serving
+what-ifs / --calibrate).
 """
 
 from dtf_tpu.plan.cost_model import Plan, PlanCost, predict, check_plan
@@ -37,6 +48,15 @@ from dtf_tpu.plan.model_stats import ModelStats, characterize
 from dtf_tpu.plan.search import search, ranked_artifact
 from dtf_tpu.plan.compile import (apply_plan, load_plan_file,
                                   plan_from_config, resolve_plan)
+from dtf_tpu.plan.serve_trace import (RequestRecord, Workload,
+                                      measured_stats, parse_workload,
+                                      scale_workload,
+                                      synthetic_workload,
+                                      workload_from_records)
+from dtf_tpu.plan.serve_model import (FleetConfig, FleetPrediction,
+                                      ServeProfile, pool_vs_shed,
+                                      rank_tp_vs_replicas,
+                                      replicas_for, simulate)
 
 __all__ = [
     "Plan", "PlanCost", "predict", "check_plan",
@@ -44,4 +64,8 @@ __all__ = [
     "ModelStats", "characterize",
     "search", "ranked_artifact",
     "apply_plan", "load_plan_file", "plan_from_config", "resolve_plan",
+    "RequestRecord", "Workload", "measured_stats", "parse_workload",
+    "scale_workload", "synthetic_workload", "workload_from_records",
+    "FleetConfig", "FleetPrediction", "ServeProfile", "pool_vs_shed",
+    "rank_tp_vs_replicas", "replicas_for", "simulate",
 ]

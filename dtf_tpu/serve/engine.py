@@ -932,9 +932,15 @@ class ServeEngine:
         req = handle.request
         req.admit_time = time.time()
         if req.trace_id is not None:
+            attrs = {}
+            if self.paged and grant is not None:
+                # prefix-share depth in TOKENS (pages are an engine
+                # detail; the capacity simulator replays recorded hits
+                # without knowing this engine's page size)
+                attrs["shared_tokens"] = len(grant[1]) * self.page_size
             trace.event("serve_admit", request=req.id, slot=slot_idx,
                         queue_wait_s=req.admit_time - req.submit_time,
-                        **_tctx(req.trace_id, req.trace_parent))
+                        **attrs, **_tctx(req.trace_id, req.trace_parent))
         if not self.paged:
             self._key, sub = jax.random.split(self._key)
             tok, self._cache, _ = self.decoder.prefill(

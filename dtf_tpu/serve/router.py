@@ -180,7 +180,7 @@ class _Request:
                  "delivered", "attempt", "next_try", "active",
                  "bp_replicas", "redispatches", "diverged", "done",
                  "submit_time", "last_dispatch", "last_progress",
-                 "trace", "span")
+                 "trace", "span", "queue_wait")
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, eos_id, deadline_s: float,
@@ -210,6 +210,7 @@ class _Request:
         self.done = False
         self.last_dispatch = 0.0
         self.last_progress = 0.0
+        self.queue_wait: Optional[float] = None
 
 
 class _Replica:
@@ -340,6 +341,10 @@ class Router:
         self._m_respawns = m.counter("router_replica_respawns_total",
                                      unit="replicas")
         self._m_latency = m.histogram("router_latency_s", unit="s")
+        # submit → first dispatch: the router-side queueing delay the
+        # capacity simulator's queueing model calibrates against
+        # (serve_stream_lag_s's missing sibling)
+        self._m_queue_wait = m.histogram("router_queue_wait_s", unit="s")
         self._m_health = [m.gauge(f"router_replica{i}_healthy",
                                   unit="bool")
                           for i in range(int(num_replicas))]
@@ -596,6 +601,14 @@ class Router:
         req.active[wire_id] = rep.id
         rep.inflight[wire_id] = req
         req.last_dispatch = time.monotonic()
+        if req.queue_wait is None:
+            # queue wait = submit → FIRST dispatch attempt (a
+            # failover's later attempts are service disruption, not
+            # queueing).  Latched BEFORE the send: a dead replica at
+            # first dispatch must not erase the sample — attempt 1
+            # never comes again
+            req.queue_wait = max(0.0, time.time() - req.submit_time)
+            self._m_queue_wait.observe(req.queue_wait)
         seq = self._dispatch_seq
         self._dispatch_seq += 1
         self._m_dispatch.inc()
@@ -612,9 +625,13 @@ class Router:
         except (OSError, ValueError, AttributeError):
             self._replica_down_locked(rep, "send_failed")
             return
+        # every dispatch record carries the latched first-attempt wait,
+        # so the trace keeps the queueing ground truth even when the
+        # attempt-1 send itself failed (no attempt-1 record exists)
         trace.event("router_dispatch", request=req.id, trace=req.trace,
                     span_id=req.span, replica=rep.id,
-                    attempt=req.attempt)
+                    attempt=req.attempt,
+                    queue_wait_s=round(req.queue_wait, 6))
         # prefix ownership: this replica's registry will hold these
         # pages once the prefill completes — route siblings here
         for digest in req.digests:

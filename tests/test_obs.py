@@ -307,6 +307,34 @@ def test_trace_main_json_mode(tmp_path, capsys):
     assert summary["events"] == {"heartbeat": 1, "trace_start": 1}
 
 
+def test_trace_main_ledger_json_machine_readable(tmp_path, capsys):
+    """--ledger --json emits the ledger rows as one JSON object — the
+    join surface plan_serve_main's calibration consumes (scraping the
+    human table was the alternative)."""
+    t = trace.configure(str(tmp_path), rank=0)
+    trace.event("ledger_exec", exec="serve_decode_step", flops=1.5e9,
+                bytes=2.0e8, peak_tflops=None, peak_hbm_gbps=None)
+    trace.event("ledger_summary", exec="serve_decode_step", count=32,
+                mean_s=0.011, achieved_tflops=0.136, mfu=None,
+                hbm_frac=None)
+    t.flush()
+    trace.disable()
+    assert trace_main([str(tmp_path), "--ledger", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    rows = payload["ledger"]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["exec"] == "serve_decode_step" and row["rank"] == "0"
+    assert row["flops"] == 1.5e9 and row["count"] == 32
+    assert row["mean_s"] == 0.011
+    # a stream with no ledger records exits 2 in json mode too
+    t2 = trace.configure(str(tmp_path / "empty"), rank=0)
+    t2.flush()
+    trace.disable()
+    assert trace_main([str(tmp_path / "empty"), "--ledger",
+                       "--json"]) == 2
+
+
 def test_trace_main_missing_dir(tmp_path):
     with pytest.raises(FileNotFoundError):
         trace_main([str(tmp_path / "empty")])

@@ -243,6 +243,28 @@ def test_placement_literal_parity_with_config():
 # degrade, never hang
 # ---------------------------------------------------------------------------
 
+def test_router_queue_wait_histogram_first_dispatch_only(tmp_path):
+    """router_queue_wait_s records submit → FIRST dispatch for every
+    dispatched request exactly once — the queueing-delay distribution
+    the capacity simulator calibrates against."""
+    router, reps = make_tier(tmp_path, 2)
+    try:
+        handles = [router.submit(np.arange(4, dtype=np.int32) + i,
+                                 max_new_tokens=4) for i in range(6)]
+        results = [h.result(timeout=20) for h in handles]
+        hist = router.metrics.get("router_queue_wait_s")
+        assert hist.count == 6, (
+            f"expected one queue-wait sample per request, got "
+            f"{hist.count}")
+        snap = hist.snapshot()
+        assert snap["min"] >= 0.0
+        # queue wait is bounded by the full latency of the slowest
+        # request — it is a PREFIX of the lifecycle, not the whole
+        assert snap["max"] <= max(r.latency_s for r in results) + 0.5
+    finally:
+        stop_tier(router, reps)
+
+
 def test_router_admission_bound_sheds_immediately(tmp_path):
     """Outstanding at the admission limit: the NEXT submit raises
     Backpressure synchronously — shed at the door, not queued into a

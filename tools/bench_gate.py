@@ -63,7 +63,7 @@ SMOKE_DEGRADE = 0.50    # --smoke halves throughput / doubles latency
 
 HIGHER_TOKENS = ("/sec", "/s/", "per_sec", "per_second", "tokens/s",
                  "images/s")
-HIGHER_NAMES = ("mfu", "hit", "throughput", "ratio", "eff")
+HIGHER_NAMES = ("mfu", "hit", "throughput", "ratio", "eff", "tflop")
 LOWER_UNITS = ("s", "ms")
 LOWER_NAMES = ("latency", "gap", "wait", "lag", "time_to", "ttft",
                "step_ms")
