@@ -78,6 +78,15 @@ Kinds and their firing semantics:
                           measured step) — the straggler signature the
                           router's deadline + least-loaded placement
                           must absorb.
+  rollout_kill@phase:P    the rollout controller (serve/rollout.py)
+                          SIGKILLs a replica as the rollout works in
+                          phase P ∈ {canary, rolling} (one-shot; an
+                          explicit ``replica<K>`` selector overrides
+                          the default target — the replica the phase
+                          is currently operating on).  The rollout
+                          must detect the instability, abort, and
+                          ROLL BACK with the fleet token-exact on the
+                          old model and zero lost requests.
 
 Every fired fault emits a structured ``injected_fault`` anomaly record
 through obs.trace (flushed before dying), so
@@ -103,7 +112,8 @@ EXIT_PREEMPTED = 75        # EX_TEMPFAIL: graceful preemption checkpoint
 EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
 
 KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
-         "reader_crash", "replica_kill", "net_partition", "slow_replica")
+         "reader_crash", "replica_kill", "net_partition", "slow_replica",
+         "rollout_kill")
 _POINTS = {
     "crash": "step",
     "sigterm": "step",
@@ -114,7 +124,10 @@ _POINTS = {
     "replica_kill": "req",
     "net_partition": "ticks",
     "slow_replica": "factor",
+    "rollout_kill": "phase",
 }
+# rollout_kill's point value is a PHASE NAME, not a number
+ROLLOUT_PHASES = ("canary", "rolling")
 # distributed kinds whose point accepts the bare-value shorthand
 # (net_partition@replica1:6) and which require/allow a replica target
 _REPLICA_REQUIRED = ("net_partition", "slow_replica")
@@ -131,6 +144,7 @@ class FaultSpec:
     value: Optional[float]  # None for point "latest"; float only for
                             # slow_replica's factor, int otherwise
     replica: Optional[int] = None  # distributed kinds: target replica
+    label: Optional[str] = None    # rollout_kill: the phase name
     fired: bool = False
 
     @property
@@ -143,7 +157,9 @@ class FaultSpec:
             sel = f"rank{self.rank}:"
         elif self.replica is not None:
             sel = f"replica{self.replica}:"
-        if self.value is None:
+        if self.label is not None:
+            p = f"{self.point}:{self.label}"
+        elif self.value is None:
             p = "latest"
         else:
             v = (self.value if self.kind == "slow_replica"
@@ -193,6 +209,15 @@ def parse_spec(text: str) -> List[FaultSpec]:
                 raise ValueError(
                     f"fault spec {tok!r}: {kind} takes the point 'latest'")
             out.append(FaultSpec(kind, rank, None, replica=replica))
+            continue
+        if want == "phase":
+            sel, _, val = point.partition(":")
+            if sel != "phase" or val not in ROLLOUT_PHASES:
+                raise ValueError(
+                    f"fault spec {tok!r}: {kind} takes "
+                    f"'phase:<{'|'.join(ROLLOUT_PHASES)}>'")
+            out.append(FaultSpec(kind, rank, None, replica=replica,
+                                 label=val))
             continue
         sel, _, val = point.partition(":")
         if not val and kind in _BARE_POINT:
@@ -358,6 +383,22 @@ class Injector:
                 return True
         return False
 
+    def rollout_kill(self, phase: str,
+                     candidate: int) -> Optional[int]:
+        """Rollout-controller-side, one-shot: returns the replica id to
+        SIGKILL when the rollout is working in ``phase`` — the explicit
+        ``replica<K>`` target if the spec named one, else ``candidate``
+        (the replica the phase is currently operating on).  None =
+        don't fire."""
+        with self._mu:
+            for spec in self._armed("rollout_kill"):
+                if spec.label == phase:
+                    target = (spec.replica if spec.replica is not None
+                              else int(candidate))
+                    self._record(spec, phase=phase, replica=target)
+                    return target
+        return None
+
     def slow_replica(self) -> float:
         """Replica-side, latched: the slow-down factor for THIS process
         (replica id == rank), or 0.0 when no slow fault targets it.  A
@@ -470,6 +511,13 @@ def net_partition(replica: int, traffic_started: bool) -> bool:
     if inj is None:
         return False
     return inj.net_partition(replica, traffic_started)
+
+
+def rollout_kill(phase: str, candidate: int) -> Optional[int]:
+    inj = _injector
+    if inj is None:
+        return None
+    return inj.rollout_kill(phase, candidate)
 
 
 def slow_replica() -> float:

@@ -21,6 +21,16 @@ Identity and rendezvous are environment + files, launcher-style:
                                   socket
   DTF_RESTART_GENERATION          respawn generation (stamped into the
                                   announce file)
+  DTF_SERVE_CHECKPOINT            checkpoint override (a model_dir or
+                                  export_dir path): serve THIS instead
+                                  of the flag-configured checkpoint —
+                                  the rollout controller's lever for
+                                  restarting one replica at a time
+                                  onto a new model (serve/rollout.py)
+  --serve_host                    address to bind AND announce; a
+                                  routable address + a shared
+                                  rendezvous dir puts this replica
+                                  behind a router on another host
   DTF_FAULT                       chaos passthrough: a
                                   slow_replica@replica<K> spec fires
                                   here when K == DTF_PROCESS_ID
@@ -62,6 +72,17 @@ def run_replica(cfg, random_init: bool = False,
     if not cfg.rendezvous_dir:
         raise ValueError("--rendezvous_dir is required (the router's "
                          "announce/heartbeat rendezvous)")
+    ckpt = os.environ.get("DTF_SERVE_CHECKPOINT", "")
+    if ckpt:
+        # rollout override: serve THIS checkpoint.  An export artifact
+        # has a model/ subdir; anything else is a train model_dir
+        if os.path.isdir(os.path.join(ckpt, "model")):
+            cfg = cfg.replace(export_dir=ckpt, model_dir="")
+        else:
+            cfg = cfg.replace(model_dir=ckpt, export_dir="")
+        random_init = False
+        log.warning("replica %d: serving rollout checkpoint %s "
+                    "(DTF_SERVE_CHECKPOINT)", replica_id, ckpt)
     _, engine = build_serving_engine(cfg, random_init=random_init,
                                      replica_rank=replica_id)
     # warm BEFORE announcing: the first request through a cold engine
@@ -77,7 +98,8 @@ def run_replica(cfg, random_init: bool = False,
     warm = np.full((min(page, engine.max_seq_len - 2),), 1, np.int32)
     engine.submit(warm, max_new_tokens=2).result(timeout=600)
     log.info("replica %d: warm (compile done)", replica_id)
-    server = ReplicaServer(engine, replica_id, cfg.rendezvous_dir)
+    server = ReplicaServer(engine, replica_id, cfg.rendezvous_dir,
+                           host=cfg.serve_host)
 
     # --metrics_port: this replica's engine registry (queue depth,
     # prefix hits, decode-step MFU ledger gauges) as a live Prometheus

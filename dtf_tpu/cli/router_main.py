@@ -51,7 +51,7 @@ _FORWARD_FLAGS = (
     "serve_max_batch", "serve_max_seq_len", "serve_queue_size",
     "serve_max_delay_ms", "kv_page_size", "kv_pool_pages",
     "serve_prefill_chunk", "serve_prefix_sharing", "serve_tp",
-    "heartbeat_secs", "rendezvous_dir",
+    "heartbeat_secs", "rendezvous_dir", "serve_host",
 )
 
 
@@ -96,11 +96,17 @@ def run_router(cfg, random_init: bool = False) -> dict:
     if cfg.metrics_port:
         extra_flags = (lambda rid:
                        ["--metrics_port", str(cfg.metrics_port + 1 + rid)])
+    # per-replica checkpoint overrides, shared BY REFERENCE between
+    # the router (the rollout controller writes it) and the spawner
+    # (reads it at spawn time → DTF_SERVE_CHECKPOINT)
+    ckpt_map: dict = {}
     spawn = replica_spawner(replica_command(cfg, random_init),
                             rendezvous, env_extra=env_extra,
-                            extra_flags=extra_flags)
+                            extra_flags=extra_flags,
+                            checkpoint_map=ckpt_map)
     router = Router(
         cfg.router_replicas, rendezvous, spawn=spawn,
+        checkpoint_map=ckpt_map,
         page_size=cfg.kv_page_size or 16,
         placement=cfg.router_placement,
         deadline_s=cfg.router_deadline_s,
@@ -159,30 +165,81 @@ def _drive_traffic(cfg, router) -> dict:
     n_groups = max(1, min(4, cfg.router_replicas))
     sys_prompts = [rng.integers(0, vocab, (2 * ps,)).astype(np.int32)
                    for _ in range(n_groups)]
+
+    def make_prompt(i):
+        tail = rng.integers(
+            0, vocab, (int(rng.integers(1, cfg.serve_prompt_len + 1)),)
+        ).astype(np.int32)
+        return np.concatenate([sys_prompts[i % n_groups], tail])
+
+    def resolve(handles, outcomes):
+        tokens = 0
+        for h in handles:
+            try:
+                r = h.result(timeout=cfg.router_deadline_s + 30)
+                tokens += len(r.tokens)
+                outcomes["ok"] += 1
+            except Backpressure:
+                outcomes["backpressure"] += 1
+            except DeadlineExceeded:
+                outcomes["deadline"] += 1
+        return tokens
+
     t0 = time.time()
     handles = []
     outcomes = {"ok": 0, "backpressure": 0, "deadline": 0}
     for i in range(cfg.serve_requests):
-        tail = rng.integers(
-            0, vocab, (int(rng.integers(1, cfg.serve_prompt_len + 1)),)
-        ).astype(np.int32)
-        prompt = np.concatenate([sys_prompts[i % n_groups], tail])
         try:
             handles.append(router.submit(
-                prompt, max_new_tokens=cfg.serve_max_new_tokens,
+                make_prompt(i), max_new_tokens=cfg.serve_max_new_tokens,
                 temperature=cfg.serve_temperature))
         except Backpressure:
             outcomes["backpressure"] += 1
-    tokens = 0
-    for h in handles:
-        try:
-            r = h.result(timeout=cfg.router_deadline_s + 30)
-            tokens += len(r.tokens)
-            outcomes["ok"] += 1
-        except Backpressure:
-            outcomes["backpressure"] += 1
-        except DeadlineExceeded:
-            outcomes["deadline"] += 1
+    tokens = resolve(handles, outcomes)
+
+    # --rollout_checkpoint: a live mid-traffic rollout — the control-
+    # surface op, driven while waves of traffic keep flowing (the
+    # canary gate compares MIRRORED LIVE requests, so the rollout
+    # needs traffic to judge the new model against)
+    rollout_state = None
+    if cfg.rollout_checkpoint:
+        import threading
+
+        box = {}
+
+        def _roll():
+            try:
+                box["state"] = router.rollout(
+                    cfg.rollout_checkpoint,
+                    state_path=cfg.rollout_state,
+                    canary_requests=cfg.rollout_canary_requests,
+                    mirror_fraction=cfg.rollout_mirror_fraction,
+                    max_divergence=cfg.rollout_max_divergence,
+                    warm_timeout_s=cfg.rollout_warm_timeout_s)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                box["error"] = e
+
+        rt = threading.Thread(target=_roll, name="rollout", daemon=True)
+        rt.start()
+        wave = 0
+        while rt.is_alive():
+            hs = []
+            for i in range(4):
+                try:
+                    hs.append(router.submit(
+                        make_prompt(wave * 4 + i),
+                        max_new_tokens=cfg.serve_max_new_tokens,
+                        temperature=cfg.serve_temperature))
+                except Backpressure:
+                    outcomes["backpressure"] += 1
+            tokens += resolve(hs, outcomes)
+            wave += 1
+            rt.join(timeout=0.25)
+        if "error" in box:
+            raise box["error"]
+        rollout_state = box.get("state")
+        log.warning("rollout finished: %s",
+                    rollout_state.phase if rollout_state else "?")
     wall = time.time() - t0
 
     out = {
@@ -199,6 +256,11 @@ def _drive_traffic(cfg, router) -> dict:
             router.replica_completed(i)
             for i in range(cfg.router_replicas)],
     }
+    if rollout_state is not None:
+        out["rollout_phase"] = rollout_state.phase
+        out["rollout_reason"] = rollout_state.reason
+        out["canary_compared"] = rollout_state.compared
+        out["canary_diverged"] = rollout_state.diverged
     if cfg.benchmark_log_dir:
         from dtf_tpu.utils.benchmark_logger import BenchmarkFileLogger
         blog = BenchmarkFileLogger(cfg.benchmark_log_dir)

@@ -75,10 +75,13 @@ KNOWN_ANOMALY_KINDS = (
     "ckpt_integrity", "injected_fault",
     # serving replica tier (dtf_tpu/serve/router.py)
     "router_shed", "replica_lost", "replica_give_up",
-    "redispatch_divergence", "router_deadline",
+    "redispatch_divergence", "router_deadline", "mixed_model",
+    # zero-downtime rollout (dtf_tpu/serve/rollout.py): the canary
+    # gate's verdicts and the rollback record
+    "canary_divergence", "rollout_rollback", "rollout_rollback_failed",
     # raw chaos kinds (the fault_kind attr of injected_fault records;
     # accepted so `--allow replica_kill`-style typos warn, not pass)
-    "replica_kill", "net_partition", "slow_replica",
+    "replica_kill", "net_partition", "slow_replica", "rollout_kill",
 )
 
 #: event kinds of the request-timeline / ledger / profiler layer —
@@ -89,7 +92,11 @@ KNOWN_EVENT_KINDS = (
     # request-scoped distributed tracing (router + serve engine)
     "router_submit", "router_dispatch", "router_requeue",
     "router_first_token", "router_complete", "router_hedge",
-    "serve_submit", "serve_admit", "serve_retire",
+    "serve_submit", "serve_admit", "serve_retire", "serve_cancelled",
+    # rollout lifecycle (serve/rollout.py + the router's rollout
+    # control surface)
+    "rollout_phase", "replica_drain", "replica_replaced",
+    "canary_mirror", "canary_compare", "canary_drop", "prefix_rehome",
     # MFU/cost ledger (obs/ledger.py)
     "ledger_exec", "ledger_summary",
     # --profile_steps output-path marker (train/loop.py)

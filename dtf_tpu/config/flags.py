@@ -309,10 +309,40 @@ class Config:
     # fallback) | least_loaded | random (the bench A/B arm)
     router_placement: str = "affinity"
     # rendezvous directory for announce + heartbeat files (router +
-    # cli/replica_main); "" = router_main picks a temp dir
+    # cli/replica_main); "" = router_main picks a temp dir.  Put it on
+    # SHARED storage and the tier goes cross-host: replicas announce
+    # host:port (--serve_host) and register/heal identically to local
+    # ones — the wire is plain TCP
     rendezvous_dir: str = ""
     # replica identity for cli/replica_main; -1 = from DTF_PROCESS_ID
     replica_id: int = -1
+    # address a replica binds AND announces (replica_rank{K}.json
+    # "host" field): 127.0.0.1 = single-host loopback (default); a
+    # routable address makes the replica reachable from a router on
+    # another host
+    serve_host: str = "127.0.0.1"
+
+    # --- zero-downtime rollout (serve/rollout.py over the router) ---
+    # rollout the tier onto this checkpoint (a model_dir or
+    # export_dir path) mid-traffic: drain one replica at a time,
+    # canary-gate the first against the old model token-by-token,
+    # auto-rollback on breach.  "" = no rollout
+    rollout_checkpoint: str = ""
+    # completed old-vs-new comparisons the canary gate requires
+    rollout_canary_requests: int = 4
+    # slice of live greedy traffic mirrored to the canary (0, 1]
+    rollout_mirror_fraction: float = 1.0
+    # gate threshold on diverged/compared; 0.0 = token-exact (any
+    # single divergence rolls back — the bench_gate discipline:
+    # identical models compare EQUAL, so a mismatch is signal)
+    rollout_max_divergence: float = 0.0
+    # how long a restarted replica gets to warm + re-register before
+    # the rollout declares the new checkpoint unserveable + rolls back
+    rollout_warm_timeout_s: float = 600.0
+    # persisted rollout state file; "" = <rendezvous>/rollout_state
+    # .json — a router restarted mid-rollout resumes or rolls back
+    # deterministically from it
+    rollout_state: str = ""
 
     # --- parallelism planner (dtf_tpu/plan) ---
     # "" = off (hand-set flags rule, the pre-planner behavior);
@@ -514,6 +544,38 @@ class Config:
             raise ValueError(
                 "router_replica_inflight/router_max_respawns/"
                 "router_respawn_backoff_s/router_hedge_s must be >= 0")
+        if not self.serve_host:
+            raise ValueError(
+                "serve_host must be a bindable address (127.0.0.1 for "
+                "single-host, a routable address for cross-host)")
+        if self.rollout_canary_requests < 1:
+            raise ValueError(
+                f"rollout_canary_requests must be >= 1, got "
+                f"{self.rollout_canary_requests}")
+        if not 0.0 < self.rollout_mirror_fraction <= 1.0:
+            raise ValueError(
+                f"rollout_mirror_fraction must be in (0, 1], got "
+                f"{self.rollout_mirror_fraction}")
+        if not 0.0 <= self.rollout_max_divergence <= 1.0:
+            raise ValueError(
+                f"rollout_max_divergence must be in [0, 1], got "
+                f"{self.rollout_max_divergence}")
+        if self.rollout_warm_timeout_s <= 0:
+            raise ValueError(
+                f"rollout_warm_timeout_s must be > 0, got "
+                f"{self.rollout_warm_timeout_s}")
+        if self.rollout_checkpoint and self.serve_temperature > 0:
+            raise ValueError(
+                "rollout_checkpoint needs greedy demo traffic "
+                "(--serve_temperature 0): the canary gate compares "
+                "mirrored GREEDY requests token-by-token — sampled "
+                "traffic is never mirrored, so the gate would starve "
+                "and every rollout would time out into a rollback")
+        if self.rollout_checkpoint and self.router_replicas < 2:
+            raise ValueError(
+                "rollout_checkpoint needs >= 2 router_replicas — the "
+                "shadow-only canary must not be the tier's only "
+                "replica")
         if self.step_time_guard_factor and self.step_time_guard_factor <= 1.0:
             raise ValueError(
                 f"step_time_guard_factor must be > 1.0 (or 0 to disable), "

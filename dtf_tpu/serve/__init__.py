@@ -13,5 +13,7 @@ from dtf_tpu.serve.engine import (Backpressure, PagePool,  # noqa: F401
                                   ServeEngine, ServeRequest, ServeResult)
 from dtf_tpu.serve.metrics import ServingStats, collect_stats  # noqa: F401
 from dtf_tpu.serve.replica import ReplicaServer  # noqa: F401
+from dtf_tpu.serve.rollout import (RolloutController,  # noqa: F401
+                                   RolloutState)
 from dtf_tpu.serve.router import (DeadlineExceeded, Router,  # noqa: F401
                                   RouterResult, replica_spawner)

@@ -38,6 +38,18 @@ the slot id and prompt length are traced arrays, never Python ints).
 
 Sampling: greedy when temperature == 0, else softmax sampling at
 ``logits / temperature`` — per-row, so one batch can mix both.
+
+Sampling RNG comes in two forms, and the distinction is a durability
+contract, not a convenience: the legacy ``key`` argument (a single
+PRNG key, split per row) makes a sampled token depend on engine-global
+step order — unreproducible after a failover — while the ``seed`` /
+``seeds`` form derives each sampled position's key as
+``fold_in(key(request_seed), position)``: a pure function of (request
+seed, position).  Two replicas holding identical params re-decoding
+the same request with the same wire-carried seed produce IDENTICAL
+sampled tokens, which is what lets the serving router re-dispatch a
+SAMPLED request token-exactly — the same failover contract greedy
+decode gets for free (serve/router.py).
 """
 
 from __future__ import annotations
@@ -123,6 +135,21 @@ def _sample(logits, temperature, key):
     sampled = jax.random.categorical(
         key, logits / safe_t[..., None], axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def position_key(seed, position):
+    """The per-request sampling key for one sequence position:
+    ``fold_in(key(seed), position)``.  A pure function of (request
+    seed, position) — the property that makes a sampled request's
+    re-dispatch token-exact on a replica with identical params."""
+    return jax.random.fold_in(
+        jax.random.key(jnp.asarray(seed, jnp.uint32)),
+        jnp.asarray(position, jnp.int32))
+
+
+# [B] seeds + [B] positions -> [B] typed keys (jitted once; the engine
+# calls this every decode step, so it must not re-trace)
+_seed_row_keys = jax.jit(jax.vmap(position_key))
 
 
 class Decoder:
@@ -356,16 +383,17 @@ class Decoder:
         tok = _sample(last, temperature, key)
         return tok, cache, last
 
-    def _decode_impl(self, params, cache, tokens, index, temperature, key):
+    def _decode_impl(self, params, cache, tokens, index, temperature,
+                     rowkeys):
         """tokens [B, 1] (the previous step's output per slot), index [B]
-        current lengths, temperature [B].  One step for every slot —
-        inactive slots decode garbage that the engine ignores."""
+        current lengths, temperature [B], rowkeys [B] per-row sampling
+        keys.  One step for every slot — inactive slots decode garbage
+        that the engine ignores."""
         logits, mut = self.model.apply(
             {"params": params, "cache": cache}, tokens,
             cache_index=index, mutable=["cache"])
         last = logits[:, -1]                               # [B, V]
-        keys = jax.random.split(key, last.shape[0])
-        toks = jax.vmap(_sample)(last, temperature, keys)
+        toks = jax.vmap(_sample)(last, temperature, rowkeys)
         return toks, mut["cache"], last
 
     # -- paged jitted bodies -------------------------------------------
@@ -391,30 +419,38 @@ class Decoder:
         return tok, mut["cache"], last
 
     def _decode_paged_impl(self, params, cache, tokens, index,
-                           block_tables, temperature, key):
+                           block_tables, temperature, rowkeys):
         """tokens [B, 1], index [B], block_tables [B, M] — rows not in
         decode phase carry an ALL-ZEROS block row, steering their
         garbage write/gather at the scratch page (ops.paged_attention).
-        """
+        ``rowkeys`` [B] are the per-row sampling keys."""
         logits, mut = self._apply_model(
             params, cache, tokens, index, block_tables, False, None)
         last = logits[:, -1]                               # [B, V]
-        keys = jax.random.split(key, last.shape[0])
-        toks = jax.vmap(_sample)(last, temperature, keys)
+        toks = jax.vmap(_sample)(last, temperature, rowkeys)
         return toks, mut["cache"], last
 
     # -- public API ----------------------------------------------------
     def prefill(self, cache, prompt, slot: int, temperature: float,
-                key) -> Tuple[Any, Any, Any]:
+                key=None, seed=None) -> Tuple[Any, Any, Any]:
         """prompt: 1-D int32 (unpadded).  Returns (token, cache, logits)
         with the first sampled token as a device scalar.  Contiguous
-        mode only — paged prefill goes through :meth:`prefill_chunk`."""
+        mode only — paged prefill goes through :meth:`prefill_chunk`.
+
+        Pass exactly one of ``key`` (a PRNG key — legacy, step-order-
+        dependent sampling) or ``seed`` (a per-request int: the sampled
+        token becomes a pure function of (seed, position) — the
+        failover-exactness form)."""
         if self.paged:
             raise RuntimeError("paged Decoder: use prefill_chunk")
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.shape[0] == 0:
             raise ValueError("prompt must be a non-empty 1-D token array")
         length = int(prompt.shape[0])
+        if (key is None) == (seed is None):
+            raise ValueError("pass exactly one of key= or seed=")
+        if seed is not None:
+            key = position_key(int(seed), length - 1)
         if length > self.max_seq_len:
             raise ValueError(
                 f"prompt length {length} exceeds max_seq_len "
@@ -427,7 +463,8 @@ class Decoder:
                              jnp.asarray(temperature, jnp.float32), key)
 
     def prefill_chunk(self, cache, chunk, block_row, start: int,
-                      sample_pos: int, temperature: float, key):
+                      sample_pos: int, temperature: float, key=None,
+                      seed=None):
         """One page-aligned prefill chunk for one slot (paged mode).
 
         chunk: 1-D int32, len(chunk) % page_size == 0 (engine-padded);
@@ -437,8 +474,14 @@ class Decoder:
         chunks and ignores the sampled token).  Returns (token, cache,
         logits) — the first-chunk (start == 0) body routes attention
         through the flash kernel; continuation chunks gather the paged
-        prefix."""
+        prefix.  Exactly one of ``key``/``seed`` (see :meth:`prefill`);
+        the seed form keys the sample to the chunk's GLOBAL sampled
+        position, so every chunking of a prompt samples identically."""
         chunk = np.asarray(chunk, np.int32).reshape(1, -1)
+        if (key is None) == (seed is None):
+            raise ValueError("pass exactly one of key= or seed=")
+        if seed is not None:
+            key = position_key(int(seed), int(start) + int(sample_pos))
         if chunk.shape[1] % self.page_size or start % self.page_size:
             raise ValueError(
                 f"prefill chunk (len {chunk.shape[1]}, start {start}) "
@@ -470,19 +513,34 @@ class Decoder:
             self._execs[ekey] = fn
         return fn(*dyn)
 
-    def decode_step(self, cache, tokens, index, temperature, key,
-                    block_tables=None):
+    def decode_step(self, cache, tokens, index, temperature, key=None,
+                    block_tables=None, seeds=None):
         """tokens [B], index [B], temperature [B] → (tokens [B], cache,
         logits [B, V]).  Paged mode additionally takes ``block_tables``
-        [B, M] (all-zeros rows for slots not decoding)."""
+        [B, M] (all-zeros rows for slots not decoding).
+
+        Exactly one of ``key`` (single PRNG key, split per row —
+        legacy) or ``seeds`` ([B] per-request ints: row b samples with
+        ``fold_in(key(seeds[b]), index[b])``, a pure function of the
+        request's seed and position — the failover-exactness form).
+        Both feed the SAME compiled body (a [B] key array), so the
+        choice never costs a recompile."""
         tokens = jnp.asarray(tokens, jnp.int32).reshape(-1, 1)
         index = jnp.asarray(index, jnp.int32)
         temperature = jnp.asarray(temperature, jnp.float32)
+        if (key is None) == (seeds is None):
+            raise ValueError("pass exactly one of key= or seeds=")
+        if seeds is not None:
+            rowkeys = _seed_row_keys(
+                jnp.asarray(seeds, jnp.uint32), index)
+        else:
+            rowkeys = jax.random.split(key, tokens.shape[0])
         if self.paged:
             if block_tables is None:
                 raise ValueError("paged decode_step needs block_tables")
             dyn = (self.params, cache, tokens, index,
-                   jnp.asarray(block_tables, jnp.int32), temperature, key)
+                   jnp.asarray(block_tables, jnp.int32), temperature,
+                   rowkeys)
             fn = self._execs.get("decode")
             if fn is None:
                 fn = (self._aot("serve_decode_step", self._decode, dyn)
@@ -490,7 +548,7 @@ class Decoder:
                 self._execs["decode"] = fn
             return fn(*dyn)
         return self._decode(self.params, cache, tokens, index,
-                            temperature, key)
+                            temperature, rowkeys)
 
 
 def teacher_forced_logits(model, params, tokens):

@@ -98,7 +98,6 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-import jax
 import numpy as np
 
 from dtf_tpu import chaos
@@ -131,6 +130,11 @@ class ServeRequest:
     # the upstream span id the per-request records link back to
     trace_id: Optional[str] = None
     trace_parent: Optional[str] = None
+    # per-request sampling seed: sampled tokens are a pure function of
+    # (rng_seed, position), so a re-dispatched SAMPLED request replays
+    # token-exactly on any replica with identical params.  None at
+    # submit = the engine derives one from (engine seed, request id)
+    rng_seed: Optional[int] = None
     # filled by the engine
     id: int = -1
     submit_time: float = 0.0
@@ -165,16 +169,37 @@ class _Handle:
 
     def __init__(self, req: ServeRequest,
                  on_token: Optional[Callable] = None,
-                 stream_lag_hist=None):
+                 stream_lag_hist=None, cond=None):
         self.request = req
         self._event = threading.Event()
         self._result: Optional[ServeResult] = None
         self._on_token = on_token
         self._lag_hist = stream_lag_hist
         self._q: "queue_mod.Queue" = queue_mod.Queue()
+        self._cancel = threading.Event()
+        self._cond = cond
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def cancel(self) -> None:
+        """Ask the engine to stop working on this request.  The engine
+        thread acts at its next iteration: a queued request resolves
+        immediately (``cancelled=True``, no tokens), a running slot
+        retires with the tokens decoded so far and frees its pages —
+        the capacity a deadline-exceeded, failed-over, or losing-hedge
+        attempt would otherwise burn decoding an answer nobody reads.
+        Safe from any thread; idempotent."""
+        self._cancel.set()
+        if self._cond is not None and self._cond.acquire(blocking=False):
+            try:
+                self._cond.notify_all()
+            finally:
+                self._cond.release()
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         if not self._event.wait(timeout):
@@ -522,7 +547,12 @@ class ServeEngine:
                                    max_seq_len=self.max_seq_len)
             self.pool = None
         self._cache = self.decoder.fresh_cache()
-        self._key = jax.random.key(seed)
+        # base for per-request sampling seeds (requests that arrive
+        # without one): a pure function of (engine seed, request id),
+        # so two same-seeded engines fed the same submission order
+        # sample identically — replica-interchangeable even for
+        # direct (router-less) callers
+        self._seed = int(seed)
 
         self._cond = threading.Condition()
         self._pending: List[_Handle] = []
@@ -590,6 +620,12 @@ class ServeEngine:
         # streaming: engine-emit → consumer-receive delay per token
         self._m_stream_lag = self.metrics.histogram("serve_stream_lag_s",
                                                     unit="s")
+        # cancellation: requests whose caller stopped wanting the
+        # answer (deadline-exceeded, failed-over, losing hedge) —
+        # each one freed a slot + pages that would otherwise decode
+        # a full budget into the stale-discard bin
+        self._m_cancelled = self.metrics.counter("serve_cancelled_total",
+                                                 unit="requests")
         self._heartbeat = heartbeat
         self._last_step_t: Optional[float] = None
         self._prefill_rr = -1           # round-robin cursor (chunk sched)
@@ -635,7 +671,8 @@ class ServeEngine:
                eos_id: Optional[int] = None,
                on_token: Optional[Callable] = None,
                trace_id: Optional[str] = None,
-               trace_parent: Optional[str] = None) -> _Handle:
+               trace_parent: Optional[str] = None,
+               rng_seed: Optional[int] = None) -> _Handle:
         """Enqueue a request.  ``on_token`` is an optional per-token
         callback invoked FROM THE ENGINE THREAD as each token retires
         (keep it cheap — it sits on the decode path); the returned
@@ -646,7 +683,13 @@ class ServeEngine:
         sends it over the replica wire; a direct caller may pass its
         own.  When tracing is on and no id arrives, the engine mints
         one, so every request's lifecycle records (submit → admit →
-        prefill chunks → decode steps → retire) share one id."""
+        prefill chunks → decode steps → retire) share one id.
+
+        ``rng_seed`` pins the request's SAMPLING identity: every
+        sampled token is fold_in(key(rng_seed), position) — so a
+        failover replay with the same seed (the router re-ships it)
+        is token-exact.  None = derived from (engine seed, request
+        id)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -673,9 +716,12 @@ class ServeEngine:
             trace_id = trace.new_trace_id()
         req = ServeRequest(prompt=prompt, max_new_tokens=int(max_new_tokens),
                            temperature=float(temperature), eos_id=eos_id,
-                           trace_id=trace_id, trace_parent=trace_parent)
+                           trace_id=trace_id, trace_parent=trace_parent,
+                           rng_seed=(None if rng_seed is None
+                                     else int(rng_seed)))
         handle = _Handle(req, on_token=on_token,
-                        stream_lag_hist=self._m_stream_lag)
+                        stream_lag_hist=self._m_stream_lag,
+                        cond=self._cond)
         with self._cond:
             # checked under the lock: a submit racing stop() must either
             # land in _pending BEFORE the stop (and get drained or
@@ -713,6 +759,11 @@ class ServeEngine:
                 raise Backpressure(retry)
             req.id = next(self._ids)
             req.submit_time = time.time()
+            if req.rng_seed is None:
+                # deterministic per (engine seed, request id); bounded
+                # to 31 bits so the wire carries a plain JSON int
+                req.rng_seed = (self._seed * 1_000_003 + req.id
+                                + 12_345) & 0x7FFFFFFF
             self._pending.append(handle)
             self._m_queue_depth.set(len(self._pending))
             if trace_id is not None:
@@ -756,6 +807,19 @@ class ServeEngine:
                 # beat(), so this is one clock read per iteration
                 self._heartbeat.beat(step=self._m_completed.value)
             with self._cond:
+                # cancellation sweep (queued half): a cancelled request
+                # that never reached a slot resolves right here —
+                # before it can cost an admission's pages
+                cancelled_pending = [h for h in self._pending
+                                     if h._cancel.is_set()]
+                for handle in cancelled_pending:
+                    self._pending.remove(handle)
+                    self._finish_cancelled(handle)
+                if cancelled_pending:
+                    # the idle branch below may wait before the normal
+                    # gauge refresh runs — a cancelled-empty queue must
+                    # not report phantom depth in the meantime
+                    self._m_queue_depth.set(len(self._pending))
                 active = any(s is not None for s in self._slots)
                 if not self._pending and not active:
                     if self._stop.is_set():
@@ -829,6 +893,14 @@ class ServeEngine:
                     for i, handle, pages in admitted:
                         self._admit(i, handle, pages)
                 self._m_admitted.inc(len(admitted))
+            # cancellation sweep (running half): a cancelled slot
+            # retires NOW — pages back to the pool, the slot to the
+            # next queued request — instead of decoding out its budget
+            # into the stale-discard bin (slots are engine-thread
+            # state; no lock needed)
+            for i, s in enumerate(self._slots):
+                if s is not None and s.handle._cancel.is_set():
+                    self._retire(i, cancelled=True)
             # chunked prefill: ONE chunk per iteration TOTAL (round-
             # robin across prefilling slots), so the gap running
             # decodes see is bounded by a single chunk's compute no
@@ -942,9 +1014,9 @@ class ServeEngine:
                         queue_wait_s=req.admit_time - req.submit_time,
                         **attrs, **_tctx(req.trace_id, req.trace_parent))
         if not self.paged:
-            self._key, sub = jax.random.split(self._key)
             tok, self._cache, _ = self.decoder.prefill(
-                self._cache, req.prompt, slot_idx, req.temperature, sub)
+                self._cache, req.prompt, slot_idx, req.temperature,
+                seed=req.rng_seed)
             first = int(tok)
             req.first_token_time = time.time()
             slot = _Slot(handle=handle, tokens=[first], last_token=first,
@@ -1005,7 +1077,6 @@ class ServeEngine:
         is_last = slot.chunk_i == len(slot.chunk_plan) - 1
         plen = int(req.prompt.size)
         sample_pos = plen - 1 - start if is_last else 0
-        self._key, sub = jax.random.split(self._key)
         t0 = time.perf_counter()
         pre_compiled = self.decoder.compiled_count
         with trace.span("serve_prefill_chunk", slot=slot_idx, start=start,
@@ -1013,7 +1084,8 @@ class ServeEngine:
                         **_tctx(req.trace_id, req.trace_parent)):
             tok, self._cache, _ = self.decoder.prefill_chunk(
                 self._cache, slot.prompt_padded[start:start + clen],
-                slot.block_row, start, sample_pos, req.temperature, sub)
+                slot.block_row, start, sample_pos, req.temperature,
+                seed=req.rng_seed)
         self._m_prefill_chunks.inc()
         slot.chunk_i += 1
         if is_last:
@@ -1055,6 +1127,7 @@ class ServeEngine:
         tokens = np.zeros((self.max_batch,), np.int32)
         index = np.zeros((self.max_batch,), np.int32)
         temps = np.zeros((self.max_batch,), np.float32)
+        seeds = np.zeros((self.max_batch,), np.uint32)
         tables = None
         if self.paged:
             tables = np.zeros((self.max_batch,
@@ -1064,11 +1137,11 @@ class ServeEngine:
                 tokens[i] = s.last_token
                 index[i] = s.index
                 temps[i] = s.handle.request.temperature
+                seeds[i] = s.handle.request.rng_seed
                 if tables is not None:
                     # prefilling / empty rows keep all-zeros rows →
                     # their garbage goes to the scratch page
                     tables[i] = s.block_row
-        self._key, sub = jax.random.split(self._key)
         attrs = {}
         if trace.enabled():
             tids = [s.handle.request.trace_id for s in self._slots
@@ -1079,7 +1152,7 @@ class ServeEngine:
         pre_compiled = self.decoder.compiled_count
         with trace.span("serve_decode", **attrs):
             out, self._cache, _ = self.decoder.decode_step(
-                self._cache, tokens, index, temps, sub,
+                self._cache, tokens, index, temps, seeds=seeds,
                 block_tables=tables)
             out = np.asarray(out)
         step_dt = time.perf_counter() - now
@@ -1120,7 +1193,21 @@ class ServeEngine:
                 or (req.eos_id is not None
                     and slot.tokens[-1] == req.eos_id))
 
-    def _retire(self, slot_idx: int):
+    def _finish_cancelled(self, handle: _Handle) -> None:
+        """Resolve a cancelled request that never occupied a slot."""
+        req = handle.request
+        self._m_cancelled.inc()
+        if req.trace_id is not None:
+            trace.event("serve_cancelled", request=req.id, tokens=0,
+                        queued=True,
+                        **_tctx(req.trace_id, req.trace_parent))
+        handle._deliver(ServeResult(
+            request_id=req.id, tokens=[], prompt_len=int(req.prompt.size),
+            queue_wait_s=0.0, time_to_first_token_s=0.0, latency_s=0.0,
+            submit_time=req.submit_time, finish_time=time.time(),
+            cancelled=True, trace_id=req.trace_id))
+
+    def _retire(self, slot_idx: int, cancelled: bool = False):
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         if slot.pages:
@@ -1138,10 +1225,27 @@ class ServeEngine:
             tokens=list(slot.tokens),
             prompt_len=int(req.prompt.size),
             queue_wait_s=req.admit_time - req.submit_time,
-            time_to_first_token_s=req.first_token_time - req.submit_time,
+            # a slot cancelled mid-prefill never produced a first
+            # token — 0.0, not (0.0 − epoch) ≈ −1.7e9
+            time_to_first_token_s=(
+                req.first_token_time - req.submit_time
+                if req.first_token_time else 0.0),
             latency_s=req.finish_time - req.submit_time,
             submit_time=req.submit_time, finish_time=req.finish_time,
-            trace_id=req.trace_id)
+            cancelled=cancelled, trace_id=req.trace_id)
+        if cancelled:
+            # an abandoned answer, not a served one: the pages are
+            # reclaimed above, but the request must not pollute the
+            # latency/completion statistics real traffic is judged by
+            self._m_cancelled.inc()
+            if req.trace_id is not None:
+                trace.event("serve_cancelled", request=req.id,
+                            tokens=len(slot.tokens), queued=False,
+                            **_tctx(req.trace_id, req.trace_parent))
+            slot.handle._deliver(result)
+            with self._cond:
+                self._cond.notify_all()
+            return
         if req.trace_id is not None:
             trace.event("serve_retire", request=req.id,
                         tokens=len(slot.tokens),

@@ -1,14 +1,13 @@
-"""Replica-side serve server: one ServeEngine behind a local socket.
+"""Replica-side serve server: one ServeEngine behind a TCP socket.
 
 The serving replica tier (serve/router.py) is N of these processes
 behind a router.  Each replica owns a full :class:`ServeEngine`
 (optionally TP-sharded — the engine doesn't know it's a replica) and
-speaks a newline-delimited-JSON wire protocol over a loopback TCP
-socket:
+speaks a newline-delimited-JSON wire protocol over TCP:
 
   router → replica
     {"op":"submit","id":W,"prompt":[...],"max_new_tokens":N,
-     "temperature":T,"eos_id":E,
+     "temperature":T,"eos_id":E,"rng_seed":S,
      "trace":TID,"pspan":SID}           dispatch one request; "trace"
                                         is the router-minted
                                         distributed-trace id and
@@ -18,7 +17,20 @@ socket:
                                         with them, so one request's
                                         life is reconstructable across
                                         processes (trace_main
-                                        --request TID)
+                                        --request TID); "rng_seed"
+                                        pins the request's SAMPLING
+                                        identity (a re-dispatch ships
+                                        the same seed, so sampled
+                                        requests replay token-exactly
+                                        — greedy's failover contract,
+                                        extended)
+    {"op":"cancel","id":W}              stop working on request W: the
+                                        engine frees its slot + pages
+                                        at the next iteration instead
+                                        of decoding an answer the
+                                        router already stopped wanting
+                                        (deadline, failover, losing
+                                        hedge)
     {"op":"drain"}                      stop admissions, finish in-flight
     {"op":"stats"}                      request a stats snapshot
 
@@ -31,11 +43,16 @@ socket:
 
 RENDEZVOUS is file-based, deliberately: the replica binds an EPHEMERAL
 port (no port-allocation coordination, no TOCTOU between picking and
-binding) and atomically writes ``replica_rank{K}.json`` — {"port",
-"pid", "generation", "ts"} — into the shared rendezvous directory.
-The router polls that file to (re)connect, so a RESPAWNED replica
-re-registers by construction: new process, new port, new announce
-content, same path.  Liveness travels separately, through the obs
+binding) and atomically writes ``replica_rank{K}.json`` — {"host",
+"port", "pid", "generation", "ts"} — into the shared rendezvous
+directory.  The router polls that file to (re)connect, so a RESPAWNED
+replica re-registers by construction: new process, new port, new
+announce content, same path.  The rendezvous directory is the tier's
+only shared-state requirement: put it on shared storage (NFS/GCS-fuse)
+and bind replicas to a routable address (``--serve_host``), and
+replicas on OTHER HOSTS register, heartbeat, and heal identically to
+local ones — the announce carries ``host:port``, and the wire is
+already plain TCP.  Liveness travels separately, through the obs
 heartbeat files (``heartbeat_rank{K}.json``) the engine rewrites every
 iteration — the router's health probe reads those, never the socket,
 so a wedged replica with a healthy TCP stack still reads as dead.
@@ -98,7 +115,8 @@ class ReplicaServer:
 
     def __init__(self, engine, replica_id: int, rendezvous_dir: str,
                  host: str = "127.0.0.1", port: int = 0,
-                 result_timeout_s: float = 600.0):
+                 result_timeout_s: float = 600.0,
+                 announce_host: Optional[str] = None):
         self.engine = engine
         self.replica_id = int(replica_id)
         self.rendezvous_dir = os.path.abspath(rendezvous_dir)
@@ -108,6 +126,11 @@ class ReplicaServer:
         self._listener.bind((host, int(port)))
         self._listener.listen(8)
         self.port = self._listener.getsockname()[1]
+        # the endpoint the ROUTER dials: the bind address, unless that
+        # is a wildcard (0.0.0.0 accepts from anywhere but is not
+        # dialable) — then the caller must name the routable address
+        self.host = announce_host or (
+            "127.0.0.1" if host in ("", "0.0.0.0") else host)
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: list = []
@@ -116,6 +139,7 @@ class ReplicaServer:
     def _announce(self) -> None:
         os.makedirs(self.rendezvous_dir, exist_ok=True)
         payload = {
+            "host": self.host,
             "port": self.port,
             "pid": os.getpid(),
             "generation": int(os.environ.get("DTF_RESTART_GENERATION",
@@ -135,12 +159,22 @@ class ReplicaServer:
             target=self._accept_loop, daemon=True,
             name=f"replica{self.replica_id}-accept")
         self._accept_thread.start()
-        log.info("replica %d: serving on 127.0.0.1:%d (rendezvous %s)",
-                 self.replica_id, self.port, self.rendezvous_dir)
+        log.info("replica %d: serving on %s:%d (rendezvous %s)",
+                 self.replica_id, self.host, self.port,
+                 self.rendezvous_dir)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            # shutdown BEFORE close: close() alone does not unblock a
+            # thread sitting in accept(2) — the syscall keeps the
+            # kernel socket referenced, so the "closed" listener keeps
+            # accepting and a router dialing a dead in-process replica
+            # reaches a ghost.  shutdown() aborts the accept.
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -199,6 +233,10 @@ class ReplicaServer:
             target=writer, daemon=True,
             name=f"replica{self.replica_id}-writer")
         wthread.start()
+        # wire id -> engine handle, for CANCEL routing (per connection:
+        # a reconnected router's cancels can only name work it
+        # dispatched on THIS connection; entries die with the request)
+        handles: dict = {}
         try:
             for line in rfile:
                 if self._stop.is_set():
@@ -211,7 +249,11 @@ class ReplicaServer:
                     continue
                 op = msg.get("op")
                 if op == "submit":
-                    self._handle_submit(msg, outq, dead)
+                    self._handle_submit(msg, outq, dead, handles)
+                elif op == "cancel":
+                    h = handles.pop(msg.get("id"), None)
+                    if h is not None and hasattr(h, "cancel"):
+                        h.cancel()
                 elif op == "drain":
                     self.engine.begin_drain()
                 elif op == "stats":
@@ -247,7 +289,8 @@ class ReplicaServer:
                     out[name] = m.value
         return out
 
-    def _handle_submit(self, msg: dict, outq, dead: threading.Event):
+    def _handle_submit(self, msg: dict, outq, dead: threading.Event,
+                       handles: dict):
         wire_id = msg["id"]
         counter = {"i": 0}
 
@@ -274,7 +317,11 @@ class ReplicaServer:
                 # including a failover replay, which arrives with the
                 # SAME trace id on a sibling
                 trace_id=msg.get("trace"),
-                trace_parent=msg.get("pspan"))
+                trace_parent=msg.get("pspan"),
+                # the request's wire-carried sampling identity: a
+                # failover replay with the same seed samples the same
+                # tokens (serve/decode.py position_key)
+                rng_seed=msg.get("rng_seed"))
         except Backpressure as bp:
             outq.put({"op": "backpressure", "id": wire_id,
                       "retry_after": float(bp.retry_after)})
@@ -283,13 +330,16 @@ class ReplicaServer:
             # must fail ITS caller, never the wire loop
             outq.put({"op": "error", "id": wire_id, "error": str(e)})
             return
+        handles[wire_id] = handle
 
         def waiter():
             try:
                 r = handle.result(timeout=self.result_timeout_s)
             except Exception as e:  # noqa: BLE001
+                handles.pop(wire_id, None)
                 outq.put({"op": "error", "id": wire_id, "error": str(e)})
                 return
+            handles.pop(wire_id, None)
             outq.put({"op": "done", "id": wire_id,
                       "tokens": [int(t) for t in r.tokens],
                       "cancelled": bool(r.cancelled),

@@ -115,6 +115,10 @@ class RouterResult:
     # produced — router events, replica spans, failover replays —
     # carries it; `trace_main --request <id>` renders the timeline
     trace_id: Optional[str] = None
+    # the model-version label of the replica(s) that served it — ONE
+    # label by construction (version-affine placement); "" outside a
+    # rollout
+    version: str = ""
 
 
 class RouterHandle:
@@ -180,11 +184,12 @@ class _Request:
                  "delivered", "attempt", "next_try", "active",
                  "bp_replicas", "redispatches", "diverged", "done",
                  "submit_time", "last_dispatch", "last_progress",
-                 "trace", "span", "queue_wait")
+                 "trace", "span", "queue_wait", "rng_seed", "version")
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
                  temperature: float, eos_id, deadline_s: float,
-                 digests: List[str], trace_id: Optional[str] = None):
+                 digests: List[str], trace_id: Optional[str] = None,
+                 rng_seed: Optional[int] = None):
         self.id = rid
         # distributed span context: one trace id for the request's
         # whole cross-process life, one router-side span id the
@@ -211,6 +216,33 @@ class _Request:
         self.last_dispatch = 0.0
         self.last_progress = 0.0
         self.queue_wait: Optional[float] = None
+        # wire-carried sampling identity: every dispatch (failover
+        # replays included) ships the SAME seed, so sampled requests
+        # replay token-exactly like greedy ones
+        self.rng_seed = rng_seed
+        # model-version affinity: latched to the FIRST dispatch's
+        # replica version — during a rollout, a failover may only
+        # land on a replica serving the same model, so a client
+        # stream is never a mix of two checkpoints
+        self.version: Optional[str] = None
+
+
+class _Shadow:
+    """Canary-mirror bookkeeping for one mirrored request: the shadow
+    copy runs on the new-checkpoint canary, its tokens are COMPARED
+    against the primary's (old model), never delivered."""
+
+    __slots__ = ("req", "wire_id", "replica", "tokens", "shadow_done",
+                 "primary", "created")
+
+    def __init__(self, req: _Request, wire_id: str, replica: int):
+        self.req = req
+        self.wire_id = wire_id
+        self.replica = replica
+        self.tokens: Optional[List[int]] = None   # canary's answer
+        self.shadow_done = False
+        self.primary: Optional[List[int]] = None  # old model's answer
+        self.created = time.monotonic()
 
 
 class _Replica:
@@ -221,6 +253,7 @@ class _Replica:
         self.rendezvous_dir = rendezvous_dir
         self.proc: Optional[subprocess.Popen] = None
         self.generation = 0
+        self.host: str = "127.0.0.1"
         self.port: Optional[int] = None
         self.announced_pid: Optional[int] = None
         self.conn: Optional[socket.socket] = None
@@ -237,6 +270,17 @@ class _Replica:
         self.respawn_at: Optional[float] = None
         self.completed = 0
         self.last_stats: Dict[str, dict] = {}   # tag -> stats msg
+        # rollout surface (serve/rollout.py): a draining replica takes
+        # no new placements; a shadow-only replica (the canary) takes
+        # ONLY mirrored traffic; hold_respawn parks the prober's
+        # auto-respawn while the rollout controller owns the process;
+        # version is the model-identity label version-affine placement
+        # matches against (all-"" outside a rollout → no constraint)
+        self.draining = False
+        self.shadow_only = False
+        self.hold_respawn = False
+        self.reconnect_block = False
+        self.version: str = ""
 
 
 class Router:
@@ -265,6 +309,7 @@ class Router:
                  respawn_backoff_s: float = 0.5,
                  hedge_s: float = 0.0,
                  kill_hook: Optional[Callable] = None,
+                 checkpoint_map: Optional[Dict[int, str]] = None,
                  seed: int = 0):
         if num_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {num_replicas}")
@@ -313,6 +358,19 @@ class Router:
         self._prefix_owner: Dict[str, int] = {}
         self._prefix_owner_cap = 65536
         self._stats_events: Dict[str, threading.Event] = {}
+        # per-replica checkpoint overrides, consulted by the spawner at
+        # spawn time (replica_spawner's checkpoint_map) — the rollout
+        # controller points a replica at the NEW checkpoint here before
+        # respawning it.  Shared BY REFERENCE with the spawner closure.
+        self.replica_checkpoints: Dict[int, str] = (
+            checkpoint_map if checkpoint_map is not None else {})
+        # canary mirroring: (replica id, fraction) while a rollout's
+        # canary arm is comparing; shadows keyed by shadow wire id +
+        # by primary request id (the comparison needs both answers)
+        self._mirror: Optional[tuple] = None
+        self._mirror_acc = 0.0
+        self._shadows: Dict[str, _Shadow] = {}
+        self._shadow_by_req: Dict[int, _Shadow] = {}
 
         # obs registry: the router's operational vocabulary
         self.metrics = MetricsRegistry()
@@ -341,6 +399,33 @@ class Router:
         self._m_respawns = m.counter("router_replica_respawns_total",
                                      unit="replicas")
         self._m_latency = m.histogram("router_latency_s", unit="s")
+        # CANCEL fan-out: stale attempts (deadline-exceeded, losing
+        # hedge, resolved-elsewhere) told to stop decoding — reclaimed
+        # replica capacity, not just discarded answers
+        self._m_cancel = m.counter("router_cancel_sent_total",
+                                   unit="requests")
+        # prefix owner-map handoff: digests re-homed to the warmest
+        # sibling when their owner is drained/replaced/lost
+        self._m_rehomed = m.counter("router_prefix_rehomed_total",
+                                    unit="digests")
+        # canary arm (rollout): mirrored shadow traffic and its
+        # token-by-token verdicts against the old model
+        self._m_mirrored = m.counter("router_canary_mirrored_total",
+                                     unit="requests")
+        self._m_compared = m.counter("router_canary_compared_total",
+                                     unit="requests")
+        self._m_canary_div = m.counter("router_canary_diverged_total",
+                                       unit="requests")
+        self._m_first_div = m.gauge("router_canary_first_divergence_pos",
+                                    unit="position")
+        self._m_first_div.set(-1)
+        # must stay 0: a client stream mixing two model versions
+        self._m_mixed = m.counter("router_mixed_model_total",
+                                  unit="requests")
+        # planned (rollout) replica replacements — NOT failures, so
+        # they are counted apart from router_replica_respawns_total
+        self._m_replaced = m.counter("router_replica_replacements_total",
+                                     unit="replicas")
         # submit → first dispatch: the router-side queueing delay the
         # capacity simulator's queueing model calibrates against
         # (serve_stream_lag_s's missing sibling)
@@ -476,9 +561,14 @@ class Router:
                               retry_after=retry, trace=trace_id)
                 raise Backpressure(retry)
             self._ids += 1
+            # the request's sampling identity is minted HERE, once —
+            # every dispatch (attempt N, hedge twin, failover replay)
+            # ships the same seed, so SAMPLED requests replay
+            # token-exactly on any same-version replica
             req = _Request(self._ids, prompt, int(max_new_tokens),
                            float(temperature), eos_id, deadline_s, digests,
-                           trace_id=trace_id)
+                           trace_id=trace_id,
+                           rng_seed=int(self._rng.integers(0, 2**31 - 1)))
             self._queue.append(req)
             self._live[req.id] = req
             self._outstanding += 1
@@ -511,6 +601,8 @@ class Router:
     def _eligible_locked(self, req: _Request, now: float) -> List[_Replica]:
         return [r for r in self._replicas
                 if not r.gave_up and r.healthy and r.conn is not None
+                and not r.draining and not r.shadow_only
+                and (req.version is None or r.version == req.version)
                 and r.saturated_until <= now
                 and r.id not in req.bp_replicas
                 and len(r.inflight) < self.replica_inflight]
@@ -560,6 +652,12 @@ class Router:
                                          for r in self._replicas))
 
     def _check_deadlines_locked(self, now: float) -> None:
+        # canary shadows outlive nothing: one that hasn't completed
+        # within its primary's deadline will never gate anything —
+        # drop it so the gate's pending count drains
+        for sh in [s for s in self._shadows.values()
+                   if now - s.created > s.req.deadline_s]:
+            self._drop_shadow_locked(sh, "shadow_timeout")
         for req in list(self._live.values()):
             if req.done or now <= req.deadline:
                 continue
@@ -580,12 +678,23 @@ class Router:
         propagate Backpressure — waiting would be a retry storm, not a
         queue.  A candidate that is merely dead/partitioned keeps the
         request queued: recovery or the deadline resolves it."""
-        candidates = [r for r in self._replicas if not r.gave_up]
-        if not candidates:
+        alive = [r for r in self._replicas if not r.gave_up]
+        # candidates = replicas that could EVER take this request:
+        # version-compatible, not shadow-only.  A draining or
+        # version-mismatched replica set is a TRANSIENT rollout state,
+        # not saturation — the request stays queued (the rollout's
+        # drain/rollback restores capacity; the deadline bounds it)
+        candidates = [r for r in alive
+                      if not r.shadow_only
+                      and (req.version is None
+                           or r.version == req.version)]
+        if not alive:
             retry = max(0.5, self.respawn_backoff_s)
-        elif all(r.healthy and (r.id in req.bp_replicas
-                                or r.saturated_until > now)
-                 for r in candidates):
+        elif candidates and all(
+                r.healthy and not r.draining
+                and (r.id in req.bp_replicas
+                     or r.saturated_until > now)
+                for r in candidates):
             retry = max(0.05, max(r.saturated_until for r in candidates)
                         - now) + self._ewma_latency
         else:
@@ -612,19 +721,28 @@ class Router:
         seq = self._dispatch_seq
         self._dispatch_seq += 1
         self._m_dispatch.inc()
-        # span context rides the wire: the replica tags its per-request
-        # records with the SAME trace id (attempt 2 after a failover
-        # included — the replay keeps the request's identity)
+        # span context + sampling identity ride the wire: the replica
+        # tags its per-request records with the SAME trace id and
+        # samples with the SAME rng_seed (attempt 2 after a failover
+        # included — the replay keeps the request's identity, token
+        # stream included)
         msg = {"op": "submit", "id": wire_id,
                "prompt": [int(t) for t in req.prompt],
                "max_new_tokens": req.max_new_tokens,
                "temperature": req.temperature, "eos_id": req.eos_id,
+               "rng_seed": req.rng_seed,
                "trace": req.trace, "pspan": req.span}
         try:
             send_msg(rep.wfile, rep.wlock, msg)
         except (OSError, ValueError, AttributeError):
             self._replica_down_locked(rep, "send_failed")
             return
+        # model-version affinity latches at the FIRST successful
+        # dispatch: from here on this request only ever runs on
+        # replicas serving the same model version (rollout invariant:
+        # no client stream mixes checkpoints)
+        if req.version is None:
+            req.version = rep.version
         # every dispatch record carries the latched first-attempt wait,
         # so the trace keeps the queueing ground truth even when the
         # attempt-1 send itself failed (no attempt-1 record exists)
@@ -639,6 +757,11 @@ class Router:
             self._prefix_owner[digest] = rep.id
         while len(self._prefix_owner) > self._prefix_owner_cap:
             self._prefix_owner.pop(next(iter(self._prefix_owner)))
+        # canary mirroring: a slice of greedy attempt-1 traffic ALSO
+        # runs on the new-checkpoint canary, compare-only
+        if (self._mirror is not None and req.attempt == 1
+                and req.temperature == 0.0):
+            self._maybe_mirror_locked(req)
         # chaos replica_kill@req:N — fire AFTER the dispatch so the
         # killed replica holds in-flight work (the case under test)
         target = chaos.replica_kill(seq, rep.id)
@@ -661,6 +784,129 @@ class Router:
             trace.event("router_hedge", request=req.id, trace=req.trace,
                         slow_replica=current, hedge_replica=rep.id)
             self._dispatch_locked(req, rep)
+
+    # -- canary mirroring (the rollout's token-exact gate arm) ----------
+    def start_mirror(self, replica_id: int, fraction: float = 1.0) -> None:
+        """Mirror ``fraction`` of greedy attempt-1 traffic to replica
+        ``replica_id`` (the new-checkpoint canary) as compare-only
+        shadows: the canary's tokens are verified token-by-token
+        against the old model's answer and NEVER delivered to a
+        client.  Greedy determinism makes any mismatch a model
+        difference, not noise — the measurable, gateable quantity the
+        rollout's canary gate rides on."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"mirror fraction must be in (0, 1], got "
+                             f"{fraction}")
+        with self._mu:
+            self._mirror = (int(replica_id), float(fraction))
+            self._mirror_acc = 0.0
+            # per-session gauge: a previous rollout's first-divergence
+            # position must not masquerade as this canary's
+            self._m_first_div.set(-1)
+
+    def stop_mirror(self) -> None:
+        with self._mu:
+            self._mirror = None
+            self._drop_shadows_locked("mirror_stopped")
+
+    def canary_stats(self) -> dict:
+        """The canary gate's inputs: comparisons completed, divergences
+        observed, and the first divergence position (-1 = none)."""
+        with self._mu:
+            return {
+                "mirrored": self._m_mirrored.value,
+                "compared": self._m_compared.value,
+                "diverged": self._m_canary_div.value,
+                "first_divergence_pos": self._m_first_div.value,
+                "pending": len(self._shadows),
+            }
+
+    def _maybe_mirror_locked(self, req: _Request) -> None:
+        rid, fraction = self._mirror
+        rep = self._replicas[rid]
+        if rep.wfile is None or not rep.healthy:
+            return
+        # deterministic fractional selection: an accumulator, not a
+        # coin flip — "mirror 1 in k" means exactly that
+        self._mirror_acc += fraction
+        if self._mirror_acc < 1.0:
+            return
+        self._mirror_acc -= 1.0
+        wire_id = f"s{req.id}"
+        sh = _Shadow(req, wire_id, rid)
+        try:
+            send_msg(rep.wfile, rep.wlock,
+                     {"op": "submit", "id": wire_id,
+                      "prompt": [int(t) for t in req.prompt],
+                      "max_new_tokens": req.max_new_tokens,
+                      "temperature": req.temperature,
+                      "eos_id": req.eos_id, "rng_seed": req.rng_seed,
+                      "trace": req.trace, "pspan": req.span})
+        except (OSError, ValueError):
+            return
+        self._shadows[wire_id] = sh
+        self._shadow_by_req[req.id] = sh
+        self._m_mirrored.inc()
+        trace.event("canary_mirror", request=req.id, trace=req.trace,
+                    replica=rid)
+
+    def _on_shadow_msg_locked(self, sh: _Shadow, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "done":
+            sh.tokens = [int(t) for t in msg.get("tokens", [])]
+            sh.shadow_done = True
+            self._compare_shadow_locked(sh)
+        elif op in ("backpressure", "error"):
+            # the canary refused the shadow: not a comparison, not a
+            # divergence — drop it (the gate counts COMPLETED compares)
+            self._drop_shadow_locked(sh, f"shadow_{op}")
+        # token msgs are ignored: the comparison runs on the final
+        # answer (greedy: the prefix property makes them equivalent)
+
+    def _compare_shadow_locked(self, sh: _Shadow) -> None:
+        if sh.tokens is None or sh.primary is None:
+            return   # the other half hasn't answered yet
+        self._shadows.pop(sh.wire_id, None)
+        self._shadow_by_req.pop(sh.req.id, None)
+        self._m_compared.inc()
+        first_div = -1
+        if sh.tokens != sh.primary:
+            n = min(len(sh.tokens), len(sh.primary))
+            first_div = next(
+                (i for i in range(n) if sh.tokens[i] != sh.primary[i]),
+                n)
+            self._m_canary_div.inc()
+            if (self._m_first_div.value < 0
+                    or first_div < self._m_first_div.value):
+                self._m_first_div.set(first_div)
+            trace.anomaly("canary_divergence", request=sh.req.id,
+                          trace=sh.req.trace, first_divergence=first_div,
+                          old=sh.primary[:8], new=sh.tokens[:8])
+        trace.event("canary_compare", request=sh.req.id,
+                    trace=sh.req.trace, diverged=first_div >= 0,
+                    first_divergence=first_div)
+
+    def _drop_shadow_locked(self, sh: _Shadow, reason: str) -> None:
+        """Abandon one shadow: forget it AND tell the canary to stop
+        decoding it — a dropped comparison must not keep burning the
+        canary capacity the remaining comparisons are waiting on."""
+        self._shadows.pop(sh.wire_id, None)
+        self._shadow_by_req.pop(sh.req.id, None)
+        if not sh.shadow_done:
+            rep = self._replicas[sh.replica]
+            if rep.wfile is not None:
+                try:
+                    send_msg(rep.wfile, rep.wlock,
+                             {"op": "cancel", "id": sh.wire_id})
+                    self._m_cancel.inc()
+                except (OSError, ValueError):
+                    pass
+        trace.event("canary_drop", request=sh.req.id, trace=sh.req.trace,
+                    reason=reason)
+
+    def _drop_shadows_locked(self, reason: str) -> None:
+        for sh in list(self._shadows.values()):
+            self._drop_shadow_locked(sh, reason)
 
     def kill_replica(self, replica_id: int) -> None:
         """SIGKILL a replica (chaos drills, the bench's kill-under-load
@@ -696,6 +942,11 @@ class Router:
             return
         with self._mu:
             wire_id = msg.get("id")
+            sh = self._shadows.get(wire_id)
+            if sh is not None and rep.id == sh.replica:
+                # canary shadow traffic: compared, never delivered
+                self._on_shadow_msg_locked(sh, msg)
+                return
             req = rep.inflight.get(wire_id)
             if req is None or req.done:
                 self._m_stale.inc()
@@ -743,6 +994,21 @@ class Router:
                     self._m_diverged.inc()
                     trace.anomaly("redispatch_divergence", request=req.id,
                                   trace=req.trace)
+                if req.version is not None and rep.version != req.version:
+                    # must be unreachable: version-affine placement
+                    # forbids it.  Counted + flagged so a regression
+                    # is an alarm, not a silent mixed-model answer
+                    self._m_mixed.inc()
+                    trace.anomaly("mixed_model", request=req.id,
+                                  trace=req.trace,
+                                  latched=req.version,
+                                  served=rep.version)
+                # the canary comparison's old-model half, if this
+                # request was mirrored
+                csh = self._shadow_by_req.get(req.id)
+                if csh is not None:
+                    csh.primary = tokens
+                    self._compare_shadow_locked(csh)
                 rep.completed += 1
                 finish = time.time()
                 latency = finish - req.submit_time
@@ -760,7 +1026,8 @@ class Router:
                     prompt_len=int(req.prompt.size), latency_s=latency,
                     replica=rep.id, redispatches=req.redispatches,
                     diverged=req.diverged, submit_time=req.submit_time,
-                    finish_time=finish, trace_id=req.trace))
+                    finish_time=finish, trace_id=req.trace,
+                    version=req.version or rep.version))
             elif op == "backpressure":
                 rep.inflight.pop(wire_id, None)
                 req.active.pop(wire_id, None)
@@ -806,8 +1073,29 @@ class Router:
         if req in self._queue:
             self._queue.remove(req)
         for wid, rid in list(req.active.items()):
-            self._replicas[rid].inflight.pop(wid, None)
+            rep = self._replicas[rid]
+            rep.inflight.pop(wid, None)
+            # CANCEL the attempts nobody is waiting on anymore (a
+            # deadline-exceeded request, a losing hedge twin): the
+            # replica frees the slot + pages at its next engine
+            # iteration instead of decoding the full budget into the
+            # stale-discard bin — exactly the capacity an overloaded
+            # or mid-rollout tier is short of.  Best-effort: a dead
+            # replica's conn is gone, and that's fine (so is it).
+            if rep.wfile is not None:
+                try:
+                    send_msg(rep.wfile, rep.wlock,
+                             {"op": "cancel", "id": wid})
+                    self._m_cancel.inc()
+                except (OSError, ValueError):
+                    pass
         req.active.clear()
+        if exc is not None:
+            # a request that resolved in failure has no old-model
+            # answer to compare — drop (and cancel) its shadow too
+            csh = self._shadow_by_req.get(req.id)
+            if csh is not None:
+                self._drop_shadow_locked(csh, "primary_failed")
         self._outstanding -= 1
         if exc is not None:
             req.handle._fail(exc)
@@ -824,8 +1112,23 @@ class Router:
                 and ann.get("pid") != rep.proc.pid:
             return False   # stale announce from the previous generation
         try:
+            # the announce carries the replica's own host:port — a
+            # replica on ANOTHER HOST (shared rendezvous storage,
+            # --serve_host a routable address) registers identically
+            # to a local one; "host" missing = a pre-fabric announce,
+            # loopback by construction
             conn = socket.create_connection(
-                ("127.0.0.1", int(ann["port"])), timeout=2.0)
+                (str(ann.get("host", "127.0.0.1")), int(ann["port"])),
+                timeout=2.0)
+            if conn.getsockname() == conn.getpeername():
+                # TCP self-connect: dialing a DEAD replica's ephemeral
+                # port can succeed via simultaneous open when the
+                # kernel picks the same source port — the router would
+                # be talking to itself and reading its own submits
+                # back.  A real replica's accept socket can never have
+                # sockname == peername.
+                conn.close()
+                return False
             # the connect timeout must NOT linger as the socket's i/o
             # timeout: an idle tier has no wire traffic, and a reader
             # whose blocking read times out after 2 quiet seconds reads
@@ -845,6 +1148,7 @@ class Router:
         self._close_conn(rep)
         rep.conn = conn
         rep.wfile = conn.makefile("wb")
+        rep.host = str(ann.get("host", "127.0.0.1"))
         rep.port = int(ann["port"])
         rep.announced_pid = ann.get("pid")
         # reader threads are daemons that exit with their connection —
@@ -894,6 +1198,16 @@ class Router:
                         if rid == rep.id]:
                 req.active.pop(wid, None)
             self._requeue_locked(req, reason=reason)
+        # shadows running on a lost canary can never complete —
+        # drop them (the gate counts completed comparisons only)
+        for sh in [s for s in self._shadows.values()
+                   if s.replica == rep.id]:
+            self._drop_shadow_locked(sh, reason)
+        # prefix owner-map HANDOFF: this replica's chained-digest
+        # entries re-home to the warmest sibling instead of going
+        # affinity-cold — the group re-prefills ONCE there and stays
+        # warm, instead of scattering across the tier
+        self._rehome_owners_locked(rep.id)
         if was_healthy:
             log.error("router: replica %d lost (%s) — %d in-flight "
                       "request(s) re-dispatched", rep.id, reason,
@@ -903,6 +1217,33 @@ class Router:
             trace.anomaly("replica_lost", replica=rep.id, reason=reason,
                           redispatched=len(stranded),
                           traces=[r.trace for r in stranded])
+
+    def _rehome_owners_locked(self, from_id: int) -> None:
+        """Re-home ``from_id``'s prefix-owner entries to the WARMEST
+        eligible sibling — the one already owning the most digests
+        (registry-warmth proxy), ties to the least loaded.  With no
+        eligible sibling the entries drop (stale owners only cost a
+        least-loaded fallback, but a wrong owner would pin traffic to
+        a cold replica forever)."""
+        owned = [d for d, o in self._prefix_owner.items()
+                 if o == from_id]
+        if not owned:
+            return
+        cands = [r for r in self._replicas
+                 if r.id != from_id and r.healthy and not r.gave_up
+                 and not r.draining and not r.shadow_only]
+        if not cands:
+            for d in owned:
+                self._prefix_owner.pop(d, None)
+            return
+        counts = collections.Counter(self._prefix_owner.values())
+        target = max(cands, key=lambda r: (counts.get(r.id, 0),
+                                           -len(r.inflight), -r.id))
+        for d in owned:
+            self._prefix_owner[d] = target.id
+        self._m_rehomed.inc(len(owned))
+        trace.event("prefix_rehome", from_replica=from_id,
+                    to_replica=target.id, digests=len(owned))
 
     def _probe_loop(self) -> None:
         while not self._stopping:
@@ -920,9 +1261,13 @@ class Router:
     def _probe_one_locked(self, rep: _Replica, now: float,
                           traffic: bool) -> None:
         # process supervision (proc mode): exits schedule a respawn
-        # under the sliding-window budget
+        # under the sliding-window budget.  hold_respawn parks this
+        # machinery while the rollout controller owns the process —
+        # a PLANNED drain-restart must not eat the crash budget (and
+        # a crash-looping NEW checkpoint must not burn it either; the
+        # controller detects that failure and rolls back)
         if (rep.proc is not None and rep.proc.poll() is not None
-                and rep.respawn_at is None):
+                and rep.respawn_at is None and not rep.hold_respawn):
             code = rep.proc.returncode
             self._replica_down_locked(rep, f"exit:{code}")
             while (rep.respawn_times and now - rep.respawn_times[0]
@@ -944,7 +1289,8 @@ class Router:
                         backoff_s=backoff,
                         respawns=len(rep.respawn_times),
                         budget=self.max_respawns)
-        if rep.respawn_at is not None and now >= rep.respawn_at:
+        if (rep.respawn_at is not None and now >= rep.respawn_at
+                and not rep.hold_respawn):
             rep.respawn_at = None
             rep.generation += 1
             self._m_respawns.inc()
@@ -976,7 +1322,7 @@ class Router:
                 self._replica_down_locked(
                     rep, "net_partition_or_stall" if partitioned
                     else "heartbeat_timeout")
-        elif fresh and not partitioned:
+        elif fresh and not partitioned and not rep.reconnect_block:
             # beats are fresh again: (re)connect and fold it back in
             if rep.conn is None and not self._connect_locked(rep):
                 return
@@ -987,6 +1333,180 @@ class Router:
             log.info("router: replica %d registered (port %s, pid %s)",
                      rep.id, rep.port, rep.announced_pid)
             self._mu.notify_all()
+
+    # -- rollout control surface (serve/rollout.py drives these) --------
+    def set_replica_version(self, replica_id: int, version: str) -> None:
+        """Label the model version replica ``replica_id`` serves.
+        Version-affine placement matches requests to it (all replicas
+        at the same label → no constraint, the steady state)."""
+        with self._mu:
+            self._replicas[replica_id].version = str(version)
+
+    def replica_version(self, replica_id: int) -> str:
+        with self._mu:
+            return self._replicas[replica_id].version
+
+    def relabel_version(self, old_label: str, new_label: str) -> None:
+        """Rename a model-version label fleet-wide: replicas AND the
+        live requests latched to it move together (a rollout baselines
+        the unlabeled incumbent fleet this way — in-flight requests
+        latched to the old label must not read as mixed-model when
+        their replica is relabeled under them)."""
+        with self._mu:
+            for rep in self._replicas:
+                if rep.version == old_label:
+                    rep.version = str(new_label)
+            for req in self._live.values():
+                if req.version == old_label:
+                    req.version = str(new_label)
+
+    def set_shadow(self, replica_id: int, shadow: bool) -> None:
+        """Shadow-only: the replica takes NO client placements, only
+        mirrored canary traffic — a new-checkpoint canary must never
+        answer a real client until the gate passes."""
+        with self._mu:
+            self._replicas[replica_id].shadow_only = bool(shadow)
+
+    def hold_replica(self, replica_id: int) -> None:
+        """Take operational ownership of one replica for a planned
+        replacement: placement stops (draining), the prober's
+        auto-respawn parks (hold_respawn), and its prefix-owner
+        entries re-home to the warmest sibling."""
+        with self._mu:
+            rep = self._replicas[replica_id]
+            rep.draining = True
+            rep.hold_respawn = True
+            self._rehome_owners_locked(replica_id)
+            self._mu.notify_all()
+
+    def release_replica(self, replica_id: int,
+                        shadow: bool = False) -> None:
+        """Return a held replica to service (``shadow=True`` = canary
+        posture: healthy and heartbeating but shadow-only)."""
+        with self._mu:
+            rep = self._replicas[replica_id]
+            rep.draining = False
+            rep.hold_respawn = False
+            rep.shadow_only = bool(shadow)
+            self._mu.notify_all()
+
+    def drain_replica(self, replica_id: int,
+                      timeout: float = 120.0) -> bool:
+        """Drain one replica: no new placements (the caller held it),
+        the replica engine sheds its own direct admissions, in-flight
+        work finishes.  True when its in-flight map emptied inside
+        ``timeout``."""
+        rep = self._replicas[replica_id]
+        with self._mu:
+            if rep.wfile is not None:
+                try:
+                    send_msg(rep.wfile, rep.wlock, {"op": "drain"})
+                except (OSError, ValueError):
+                    pass
+            trace.event("replica_drain", replica=replica_id,
+                        inflight=len(rep.inflight))
+            deadline = time.monotonic() + timeout
+            while rep.inflight and time.monotonic() < deadline:
+                self._mu.wait(timeout=0.05)
+            return not rep.inflight
+
+    def terminate_replica(self, replica_id: int,
+                          timeout: float = 30.0) -> None:
+        """Stop a held replica's process for a planned replacement:
+        mark it down QUIETLY (no replica_lost anomaly — a drained
+        planned exit is not a casualty), SIGTERM, reap.  Proc-less
+        tiers (tests) just close the transport."""
+        rep = self._replicas[replica_id]
+        with self._mu:
+            rep.healthy = False
+            # park the prober's reconnect too: between this terminate
+            # and the successor's announce, the OLD endpoint (or its
+            # stale-but-fresh heartbeat) must not be folded back in.
+            # spawn_replica / allow_reconnect lifts it.
+            rep.reconnect_block = True
+            self._m_health[rep.id].set(0)
+            self._close_conn(rep)
+            # anything still in flight (drain timed out) fails over
+            stranded = list(rep.inflight.values())
+            rep.inflight.clear()
+            for req in stranded:
+                for wid in [w for w, rid in req.active.items()
+                            if rid == rep.id]:
+                    req.active.pop(wid, None)
+                self._requeue_locked(req, reason="planned_restart")
+        if rep.proc is not None and rep.proc.poll() is None:
+            rep.proc.terminate()
+            try:
+                rep.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait()
+
+    def spawn_replica(self, replica_id: int) -> None:
+        """Spawn a held replica's next generation (proc mode).  The
+        spawner consults ``replica_checkpoints[replica_id]`` — set it
+        first to point the new process at a different checkpoint.
+        Counted as a REPLACEMENT, not a respawn: planned restarts
+        must not look like crashes on any dashboard."""
+        if self._spawn is None:
+            raise RuntimeError(
+                "router does not own replica processes (no spawner) — "
+                "pass restart_hook to the rollout controller instead")
+        from dtf_tpu.serve.replica import announce_path
+        rep = self._replicas[replica_id]
+        with self._mu:
+            for path in (heartbeat_path(self.rendezvous_dir, rep.id),
+                         announce_path(self.rendezvous_dir, rep.id)):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            rep.generation += 1
+            rep.respawn_at = None
+            rep.hb_mtime = None
+            rep.last_beat_ts = None
+            rep.last_beat_mono = time.monotonic()   # startup grace
+            rep.saturated_until = 0.0
+            rep.reconnect_block = False
+            gen = rep.generation
+        self._m_replaced.inc()
+        rep.proc = self._spawn(rep.id, gen)
+        trace.event("replica_replaced", replica=rep.id, generation=gen,
+                    checkpoint=self.replica_checkpoints.get(rep.id, ""))
+
+    def allow_reconnect(self, replica_id: int) -> None:
+        """Lift the terminate-window reconnect block (proc-less tiers:
+        the restart_hook's successor replica has announced)."""
+        with self._mu:
+            rep = self._replicas[replica_id]
+            rep.reconnect_block = False
+            rep.last_beat_mono = time.monotonic()   # startup grace
+
+    def replica_exit_code(self, replica_id: int) -> Optional[int]:
+        """The replica process's exit code, or None while it runs (and
+        in proc-less tiers) — the rollout controller's fast-fail
+        signal for a new checkpoint that cannot even start."""
+        proc = self._replicas[replica_id].proc
+        return None if proc is None else proc.poll()
+
+    def replica_draining(self, replica_id: int) -> bool:
+        with self._mu:
+            return self._replicas[replica_id].draining
+
+    def prefix_owner_count(self, replica_id: int) -> int:
+        """How many prefix digests currently route to this replica
+        (the owner-map-handoff observability hook)."""
+        with self._mu:
+            return sum(1 for o in self._prefix_owner.values()
+                       if o == replica_id)
+
+    def rollout(self, new_checkpoint: str, **kw):
+        """The router's rollout control-surface op: run a zero-downtime
+        rolling rollout of the whole tier onto ``new_checkpoint`` (see
+        serve/rollout.py for the state machine).  Returns the final
+        RolloutState."""
+        from dtf_tpu.serve.rollout import RolloutController
+        return RolloutController(self, new_checkpoint, **kw).run()
 
     # -- introspection -------------------------------------------------
     def health(self) -> dict:
@@ -1040,7 +1560,9 @@ def replica_spawner(cmd: List[str], rendezvous_dir: str,
                     log_dir: Optional[str] = None,
                     env_extra: Optional[dict] = None,
                     cwd: Optional[str] = None,
-                    extra_flags: Optional[Callable] = None) -> Callable:
+                    extra_flags: Optional[Callable] = None,
+                    checkpoint_map: Optional[Dict[int, str]] = None
+                    ) -> Callable:
     """Standard spawn callable for :class:`Router`: runs ``cmd`` with
     the replica-tier environment contract — DTF_PROCESS_ID = replica
     id (announce/heartbeat/trace rank identity), DTF_HEARTBEAT_DIR =
@@ -1050,7 +1572,13 @@ def replica_spawner(cmd: List[str], rendezvous_dir: str,
     first failure's log like the launcher does).  ``extra_flags``
     (``replica_id -> [flag, ...]``) appends PER-REPLICA flags — the
     metrics-port fan-out (router_main gives replica K port base+1+K so
-    one ``--metrics_port`` makes the whole tier scrapable)."""
+    one ``--metrics_port`` makes the whole tier scrapable).
+    ``checkpoint_map`` (shared BY REFERENCE with
+    ``Router.replica_checkpoints``) is consulted at SPAWN time: a
+    non-empty entry exports DTF_SERVE_CHECKPOINT, which replica_main
+    serves instead of its flag-configured checkpoint — the mechanism a
+    rollout uses to restart one replica at a time onto a new
+    checkpoint without touching the other replicas' command line."""
     rendezvous_dir = os.path.abspath(rendezvous_dir)
     log_dir = os.path.abspath(log_dir or rendezvous_dir)
     # the replica must import dtf_tpu no matter where the ROUTER was
@@ -1070,6 +1598,9 @@ def replica_spawner(cmd: List[str], rendezvous_dir: str,
         env["PYTHONPATH"] = (repo_root + os.pathsep
                              + env.get("PYTHONPATH", ""))
         env.update(env_extra or {})
+        ckpt = (checkpoint_map or {}).get(replica_id, "")
+        if ckpt:
+            env["DTF_SERVE_CHECKPOINT"] = ckpt
         os.makedirs(log_dir, exist_ok=True)
         suffix = f".retry{generation}" if generation else ""
         logf = open(os.path.join(

@@ -136,10 +136,36 @@ def verify_step(ckpt_directory: str, step: int) -> str:
     return "ok"
 
 
+def truncate_largest_file(directory: str) -> Optional[str]:
+    """Halve the largest file under ``directory`` — the SHARED chaos
+    payload behind every ckpt_truncate fault (the torn-write a
+    preempted save or interrupted upload leaves behind, minus the
+    nondeterminism).  Returns the truncated path, or None when the
+    tree holds no files."""
+    largest: Tuple[int, Optional[str]] = (0, None)
+    for root, _, names in os.walk(os.path.abspath(directory)):
+        for name in names:
+            full = os.path.join(root, name)
+            try:
+                size = os.path.getsize(full)
+            except OSError:
+                continue
+            if size > largest[0]:
+                largest = (size, full)
+    size, victim = largest
+    if victim is None:
+        return None
+    with open(victim, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    log.error("chaos: truncated %s (%d -> %d bytes)", victim, size,
+              max(size // 2, 1))
+    return victim
+
+
 def _chaos_truncate_newest(ckpt_directory: str) -> None:
-    """ckpt_truncate@latest fault action: halve the largest payload file
-    of the newest step directory — the torn-write a preempted save
-    leaves behind, minus the nondeterminism."""
+    """ckpt_truncate@latest fault action against a train checkpoint
+    tree: halve the largest payload file of the NEWEST step
+    directory."""
     try:
         steps = sorted(int(d) for d in os.listdir(ckpt_directory)
                        if d.isdigit())
@@ -147,21 +173,7 @@ def _chaos_truncate_newest(ckpt_directory: str) -> None:
         return
     if not steps:
         return
-    step_dir = os.path.join(ckpt_directory, str(steps[-1]))
-    largest: Tuple[int, Optional[str]] = (0, None)
-    for root, _, names in os.walk(step_dir):
-        for name in names:
-            full = os.path.join(root, name)
-            size = os.path.getsize(full)
-            if size > largest[0]:
-                largest = (size, full)
-    if largest[1] is None:
-        return
-    size, victim = largest
-    with open(victim, "r+b") as f:
-        f.truncate(max(size // 2, 1))
-    log.error("chaos: truncated %s (%d -> %d bytes) in checkpoint step "
-              "%d", victim, size, max(size // 2, 1), steps[-1])
+    truncate_largest_file(os.path.join(ckpt_directory, str(steps[-1])))
 
 
 class Checkpointer:

@@ -35,18 +35,32 @@ def _no_chaos_leak():
     chaos.disable()
 
 
-def oracle(prompt, n):
+def oracle(prompt, n, seed=0, temperature=0.0, salt=0):
     """The fake engine's deterministic decode: token i of a prompt is a
     pure function of (prompt, i) — replica-interchangeable, like greedy
-    decode over identical params."""
+    decode over identical params.  ``temperature > 0`` mixes in the
+    per-request ``seed`` (the wire-carried sampling identity: same
+    seed → same tokens, like the real engine's fold_in(key(seed),
+    pos)); ``salt`` models a DIFFERENT CHECKPOINT (rollout tests: a
+    new model answers differently)."""
     s = int(np.asarray(prompt, np.int64).sum()) % 97
-    return [(s * 31 + i * 7) % 97 for i in range(n)]
+    out = []
+    for i in range(n):
+        t = (s * 31 + i * 7 + salt) % 97
+        if temperature > 0:
+            t = (t + (int(seed) * 13 + i * (int(seed) % 7 + 1))) % 97
+        out.append(t)
+    return out
 
 
 class _FakeHandle:
     def __init__(self):
         self._ev = threading.Event()
         self._res = None
+        self._cancel = threading.Event()
+
+    def cancel(self):
+        self._cancel.set()
 
     def result(self, timeout=None):
         if not self._ev.wait(timeout):
@@ -64,14 +78,17 @@ class _FakeResult:
 
 class FakeEngine:
     """ServeEngine's wire-facing surface (submit/begin_drain/
-    outstanding) over the oracle, with a per-token delay so kills can
-    land mid-request."""
+    outstanding/cancel-able handles) over the oracle, with a per-token
+    delay so kills can land mid-request.  ``salt`` models the
+    checkpoint identity (rollout tests)."""
 
-    def __init__(self, tok_delay=0.004, queue_limit=64):
+    def __init__(self, tok_delay=0.004, queue_limit=64, salt=0):
         self.tok_delay = tok_delay
         self.queue_limit = queue_limit
+        self.salt = salt
         self._n = 0
         self.submitted = 0
+        self.cancelled_count = 0
         self._mu = threading.Lock()
         self.draining = False
         self.dead = False
@@ -79,6 +96,9 @@ class FakeEngine:
         # propagation tests assert the router's span context crossed
         # the real wire intact (failover replay included)
         self.trace_ids = []
+        # rng_seed per submit, in order — the sampled-replay tests
+        # assert the SAME seed crossed the wire on every attempt
+        self.rng_seeds = []
 
     @property
     def outstanding(self):
@@ -89,20 +109,29 @@ class FakeEngine:
 
     def submit(self, prompt, max_new_tokens=32, temperature=0.0,
                eos_id=None, on_token=None, trace_id=None,
-               trace_parent=None):
+               trace_parent=None, rng_seed=None):
         with self._mu:
             self.trace_ids.append((trace_id, trace_parent))
+            self.rng_seeds.append(rng_seed)
             if self.draining or self._n >= self.queue_limit:
                 raise Backpressure(0.3)
             self._n += 1
             self.submitted += 1
         handle = _FakeHandle()
-        toks = oracle(prompt, max_new_tokens)
+        toks = oracle(prompt, max_new_tokens, seed=rng_seed or 0,
+                      temperature=temperature, salt=self.salt)
 
         def run():
             for t in toks:
                 if self.dead:
                     return      # a killed replica never answers
+                if handle._cancel.is_set():
+                    # engine-level cancellation: stop decoding, free
+                    # the (fake) slot — the wire CANCEL's effect
+                    with self._mu:
+                        self._n -= 1
+                        self.cancelled_count += 1
+                    return
                 time.sleep(self.tok_delay)
                 if on_token:
                     on_token(t)
@@ -119,8 +148,9 @@ class FakeReplica:
     """ReplicaServer + FakeEngine + a heartbeat thread — everything a
     replica process provides, minus the process."""
 
-    def __init__(self, rid, rdir, **engine_kw):
+    def __init__(self, rid, rdir, host="127.0.0.1", **engine_kw):
         self.rid, self.rdir, self.engine_kw = rid, rdir, engine_kw
+        self.host = host
         self.engine = None
         self.server = None
         self._hb_stop = None
@@ -128,7 +158,7 @@ class FakeReplica:
     def start(self):
         self.engine = FakeEngine(**self.engine_kw)
         self.server = ReplicaServer(self.engine, self.rid,
-                                    self.rdir).start()
+                                    self.rdir, host=self.host).start()
         self._hb_stop = threading.Event()
         hb = Heartbeat(heartbeat_path(self.rdir, self.rid),
                        interval_s=0.04)
@@ -589,6 +619,226 @@ def test_trace_id_propagates_over_wire_and_failover(tmp_path):
     finally:
         stop_tier(router, reps)
         trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# per-request RNG seeds: sampled requests replay token-exactly
+# ---------------------------------------------------------------------------
+
+def test_sampled_failover_replays_token_exact(tmp_path):
+    """SAMPLED (temperature > 0) requests carry a router-minted
+    rng_seed on the wire; a failover re-dispatch ships the SAME seed,
+    so the replay is token-exact — greedy's failover contract,
+    extended to sampling."""
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.02))
+    try:
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, 97, (6,)).astype(np.int32)
+                   for _ in range(4)]
+        handles = [router.submit(p, max_new_tokens=25, temperature=1.0)
+                   for p in prompts]
+        streams = [[] for _ in handles]
+        threads = [threading.Thread(
+            target=lambda h=h, out=out: out.extend(h.stream(timeout=30)),
+            daemon=True) for h, out in zip(handles, streams)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        reps[0].kill()
+        results = [h.result(timeout=30) for h in handles]
+        for t in threads:
+            t.join(timeout=30)
+        victims = [(r, s, p) for r, s, p in
+                   zip(results, streams, prompts) if r.redispatches]
+        assert victims, "the kill should have stranded work"
+        all_seeds = [s for rep in reps for s in rep.engine.rng_seeds]
+        assert all(s is not None for s in all_seeds), (
+            "every wire submit must carry a rng_seed")
+        for r, s, p in victims:
+            # both replicas saw the SAME seed for this request, and
+            # the final tokens are the seeded oracle's — i.e. the
+            # replay reproduced the original sampling exactly
+            seeds = {rep.engine.rng_seeds[i]
+                     for rep in reps
+                     for i, (t, _) in enumerate(rep.engine.trace_ids)
+                     if t == r.trace_id}
+            assert len(seeds) == 1, f"seed changed across failover: {seeds}"
+            (seed,) = seeds
+            want = oracle(p, 25, seed=seed, temperature=1.0)
+            assert r.tokens == want
+            assert s == want, "stream must dedupe the seeded replay"
+            assert not r.diverged, (
+                "a seeded sampled replay must not diverge")
+        assert router.metrics.get(
+            "router_redispatch_divergence_total").value == 0
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# CANCEL: stale attempts stop decoding
+# ---------------------------------------------------------------------------
+
+def test_cancel_on_deadline_frees_engine(tmp_path):
+    """A deadline-exceeded request's in-flight attempt gets a wire
+    CANCEL: the (fake) engine stops decoding and frees its slot
+    instead of burning the full budget on a stale answer."""
+    router, reps = make_tier(tmp_path, 1,
+                             engine_kw=dict(tok_delay=0.2))
+    try:
+        h = router.submit(np.arange(5, dtype=np.int32),
+                          max_new_tokens=50, deadline_s=0.4)
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=5)
+        assert router.metrics.get("router_cancel_sent_total").value >= 1
+        t0 = time.monotonic()
+        while (reps[0].engine.cancelled_count < 1
+               and time.monotonic() - t0 < 5):
+            time.sleep(0.02)
+        assert reps[0].engine.cancelled_count == 1, (
+            "the engine never acted on the CANCEL")
+        assert reps[0].engine.outstanding == 0, (
+            "the cancelled request still occupies the engine")
+    finally:
+        stop_tier(router, reps)
+
+
+def test_cancel_on_losing_hedge(tmp_path):
+    """First-done-wins hedging: the LOSING attempt is cancelled, not
+    left to decode its full budget as a stale discard."""
+    router, reps = make_tier(
+        tmp_path, 2, router_kw=dict(hedge_s=0.15,
+                                    placement="least_loaded"),
+        engine_kw=dict(tok_delay=0.004))
+    try:
+        reps[0].engine.tok_delay = 1.0   # replica 0 stalls, stays alive
+        p = np.arange(9, dtype=np.int32)
+        r = router.submit(p, max_new_tokens=8).result(timeout=10)
+        assert r.replica == 1
+        assert router.metrics.get("router_cancel_sent_total").value >= 1
+        t0 = time.monotonic()
+        while (reps[0].engine.cancelled_count < 1
+               and time.monotonic() - t0 < 5):
+            time.sleep(0.02)
+        assert reps[0].engine.cancelled_count == 1
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# prefix owner-map handoff
+# ---------------------------------------------------------------------------
+
+def test_prefix_owner_rehomes_to_warm_sibling(tmp_path):
+    """When a replica dies, its chained-digest owner entries re-home
+    to ONE warm sibling instead of dropping cold: the group's next
+    requests all land together (one re-prefill, then warm), and the
+    rehome counter + owner count prove it was the handoff."""
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.01))
+    try:
+        ps = router.page_size
+        rng = np.random.default_rng(21)
+        group = rng.integers(0, 97, (2 * ps,)).astype(np.int32)
+        # warm the group onto some replica
+        router.submit(group, max_new_tokens=4).result(timeout=10)
+        owner = next(i for i in range(2)
+                     if router.prefix_owner_count(i) > 0)
+        other = 1 - owner
+        reps[owner].kill()
+        t0 = time.monotonic()
+        while router.replica_healthy(owner) and time.monotonic() - t0 < 5:
+            time.sleep(0.02)
+        assert router.metrics.get(
+            "router_prefix_rehomed_total").value >= 1
+        assert router.prefix_owner_count(owner) == 0
+        assert router.prefix_owner_count(other) >= 1, (
+            "the dead owner's digests were dropped, not re-homed")
+        # the group's traffic now routes to the sibling as AFFINITY
+        # hits (the owner map still answers), all to one replica
+        hits0 = router.metrics.get("router_affinity_hits_total").value
+        before = reps[other].engine.submitted
+        hs = [router.submit(
+            np.concatenate([group,
+                            rng.integers(0, 97, (3,)).astype(np.int32)]),
+            max_new_tokens=4) for _ in range(4)]
+        for h in hs:
+            h.result(timeout=10)
+        assert reps[other].engine.submitted - before == 4
+        assert router.metrics.get(
+            "router_affinity_hits_total").value - hits0 >= 4
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# cross-host rendezvous: host:port announce
+# ---------------------------------------------------------------------------
+
+def test_cross_host_rendezvous_second_address(tmp_path):
+    """A replica bound to a second address (127.0.0.2 — standing in
+    for another host) announces host:port; the router dials the
+    ANNOUNCED host, not a hardcoded loopback — the cross-host fabric
+    contract, exercised without needing two machines."""
+    rdir = str(tmp_path / "rdv")
+    os.makedirs(rdir, exist_ok=True)
+    reps = [FakeReplica(0, rdir, host="127.0.0.2").start(),
+            FakeReplica(1, rdir).start()]
+    ann = read_announce(rdir, 0)
+    assert ann["host"] == "127.0.0.2", (
+        "the announce must carry the replica's dialable host")
+    router = Router(2, rdir, probe_interval_s=0.05,
+                    health_timeout_s=0.3, deadline_s=30.0,
+                    replica_inflight=32, page_size=8,
+                    kill_hook=lambda rid: reps[rid].kill())
+    router.start(wait_s=10)
+    try:
+        # force traffic onto the cross-host replica: drain the local
+        # one so placement has exactly one choice
+        reps[1].engine.draining = True
+        p = np.arange(5, dtype=np.int32)
+        r = router.submit(p, max_new_tokens=6).result(timeout=10)
+        assert r.tokens == oracle(p, 6)
+        assert r.replica == 0
+        assert reps[0].engine.submitted >= 1
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
+# model-version affinity (the rollout's no-mixed-stream invariant)
+# ---------------------------------------------------------------------------
+
+def test_version_affinity_pins_failover_to_same_model(tmp_path):
+    """A request latched to model version A never fails over to a
+    version-B replica: it waits (deadline-bounded) until an A replica
+    returns, then completes token-exact — a client stream is NEVER a
+    mix of two checkpoints."""
+    router, reps = make_tier(tmp_path, 2, engine_kw=dict(tok_delay=0.05))
+    try:
+        router.set_replica_version(0, "old")
+        router.set_replica_version(1, "new")
+        # force the request onto replica 0 ("old")
+        router.set_shadow(1, True)
+        p = np.arange(7, dtype=np.int32)
+        h = router.submit(p, max_new_tokens=30)
+        time.sleep(0.15)           # a few tokens in on replica 0
+        router.set_shadow(1, False)
+        reps[0].kill()
+        # replica 1 is healthy but serves "new" — the request must NOT
+        # land there; it waits for an "old" replica
+        time.sleep(1.0)
+        assert not h.done(), (
+            "the version-latched request ran on the wrong model")
+        before = reps[1].engine.submitted
+        reps[0] = FakeReplica(0, reps[0].rdir,
+                              tok_delay=0.05).start()
+        r = h.result(timeout=15)
+        assert r.tokens == oracle(p, 30)
+        assert reps[1].engine.submitted == before, (
+            "the new-version replica served an old-version request")
+        assert router.metrics.get("router_mixed_model_total").value == 0
+    finally:
+        stop_tier(router, reps)
 
 
 # ---------------------------------------------------------------------------

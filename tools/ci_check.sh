@@ -77,9 +77,20 @@
 #      tokens/s or p99 latency leave the 2x ratio bar — with the
 #      plan_serve_*_ratio gauges exported to metric.log like stage
 #      6's plan_step_time_ratio.
+#  12. tools/rollout_smoke.py — the zero-downtime-rollout contract
+#      (serve/rollout.py over real replica subprocesses + real
+#      exported checkpoints): a mid-traffic rollout to a re-exported
+#      IDENTICAL checkpoint completes (DONE) with zero shed / lost /
+#      mixed-model requests, token-exact vs baseline, prefix affinity
+#      still warm after the whole fleet restarted; a rollout to a
+#      genuinely different checkpoint is CAUGHT by the token-exact
+#      canary gate and auto-rolls-back; rollout_kill chaos mid-rollout
+#      and a truncated NEW checkpoint both resolve to ROLLED_BACK with
+#      the fleet token-exact on the old model; and `trace_main
+#      --check` with the rollout allowlist is green.
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-11 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-12 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -87,18 +98,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/11]: tier-1 test suite =="
+    echo "== ci_check [1/12]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/11]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/12]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/11]: marker audit (test-budget contract) =="
+echo "== ci_check [2/12]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/11]: traced smoke run =="
+echo "== ci_check [3/12]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -106,13 +117,13 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/11]: anomaly cleanliness =="
+echo "== ci_check [4/12]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
 
-echo "== ci_check [5/11]: chaos smoke (kill -> resume -> exactness) =="
+echo "== ci_check [5/12]: chaos smoke (kill -> resume -> exactness) =="
 python tools/chaos_smoke.py
 
-echo "== ci_check [6/11]: parallelism planner (check + calibration) =="
+echo "== ci_check [6/12]: parallelism planner (check + calibration) =="
 python bench_plan.py --out "$TRACE_DIR/PLAN_4x4.json" >/dev/null
 python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
     --dataset lm --use_synthetic_data --seq_len 64 --batch_size 8 \
@@ -126,21 +137,24 @@ python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
     --benchmark_log_dir "$TRACE_DIR/plan_bench"
 grep -q plan_step_time_ratio "$TRACE_DIR/plan_bench/metric.log"
 
-echo "== ci_check [7/11]: data-service smoke (sharded determinism + imagenet resume exactness) =="
+echo "== ci_check [7/12]: data-service smoke (sharded determinism + imagenet resume exactness) =="
 python tools/data_service_smoke.py
 
-echo "== ci_check [8/11]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
+echo "== ci_check [8/12]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
 python tools/serve_smoke.py
 
-echo "== ci_check [9/11]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
+echo "== ci_check [9/12]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
 python tools/router_smoke.py
 
-echo "== ci_check [10/11]: perf-regression gate (committed history passes, injected regression fails) =="
+echo "== ci_check [10/12]: perf-regression gate (committed history passes, injected regression fails) =="
 python tools/bench_gate.py --smoke
 
-echo "== ci_check [11/11]: capacity-simulator smoke (record -> replay -> calibrate) =="
+echo "== ci_check [11/12]: capacity-simulator smoke (record -> replay -> calibrate) =="
 python -m dtf_tpu.cli.plan_serve_main --calibrate --calibrate_tolerance 2.0 \
     --benchmark_log_dir "$TRACE_DIR/serve_plan_bench"
 grep -q plan_serve_tokens_ratio "$TRACE_DIR/serve_plan_bench/metric.log"
+
+echo "== ci_check [12/12]: rollout smoke (zero-downtime rollout: canary gate, rollback, rollout chaos) =="
+python tools/rollout_smoke.py
 
 echo "ci_check: OK"
