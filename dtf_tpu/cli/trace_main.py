@@ -66,42 +66,12 @@ from typing import Dict, List
 
 from dtf_tpu.obs.registry import Histogram
 from dtf_tpu.obs.trace import read_records
-
-
-#: anomaly kinds the subsystems emit (docs for --allow; unknown kinds
-#: only warn — forward compatibility beats a stale registry)
-KNOWN_ANOMALY_KINDS = (
-    "nan_loss", "step_time_regression", "reader_lag", "serve_shed",
-    "ckpt_integrity", "injected_fault",
-    # serving replica tier (dtf_tpu/serve/router.py)
-    "router_shed", "replica_lost", "replica_give_up",
-    "redispatch_divergence", "router_deadline", "mixed_model",
-    # zero-downtime rollout (dtf_tpu/serve/rollout.py): the canary
-    # gate's verdicts and the rollback record
-    "canary_divergence", "rollout_rollback", "rollout_rollback_failed",
-    # raw chaos kinds (the fault_kind attr of injected_fault records;
-    # accepted so `--allow replica_kill`-style typos warn, not pass)
-    "replica_kill", "net_partition", "slow_replica", "rollout_kill",
-)
-
-#: event kinds of the request-timeline / ledger / profiler layer —
-#: never anomalies, but part of the vocabulary the --allow typo check
-#: validates against: `--allow serve_retire` is a harmless no-op on a
-#: known name, while `--allow serve_retier` still warns loudly
-KNOWN_EVENT_KINDS = (
-    # request-scoped distributed tracing (router + serve engine)
-    "router_submit", "router_dispatch", "router_requeue",
-    "router_first_token", "router_complete", "router_hedge",
-    "serve_submit", "serve_admit", "serve_retire", "serve_cancelled",
-    # rollout lifecycle (serve/rollout.py + the router's rollout
-    # control surface)
-    "rollout_phase", "replica_drain", "replica_replaced",
-    "canary_mirror", "canary_compare", "canary_drop", "prefix_rehome",
-    # MFU/cost ledger (obs/ledger.py)
-    "ledger_exec", "ledger_summary",
-    # --profile_steps output-path marker (train/loop.py)
-    "profiler_trace",
-)
+# the trace vocabulary is single-sourced in obs/vocab.py: this CLI's
+# --allow typo check and the dtflint closure rule (trace-unregistered /
+# trace-unemitted) validate against ONE registry.  Re-exported here for
+# callers that historically imported the tuples from trace_main.
+from dtf_tpu.obs.vocab import (KNOWN_ANOMALY_KINDS,  # noqa: F401
+                               KNOWN_EVENT_KINDS, allowable_kinds)
 
 
 def discover(paths: List[str]) -> List[str]:
@@ -348,8 +318,7 @@ def main(argv=None) -> int:
 
     files = discover(args.paths)
     allowed = set(args.allow)
-    for kind in sorted(allowed - set(KNOWN_ANOMALY_KINDS)
-                       - set(KNOWN_EVENT_KINDS)):
+    for kind in sorted(allowed - allowable_kinds()):
         # warn, don't fail: new subsystems may emit kinds this registry
         # hasn't learned — but a typo'd --allow silently tolerating
         # nothing is exactly the bug an expected-anomaly list invites

@@ -56,9 +56,9 @@ class Config:
     # --loss_scale: a number (static scale) or "dynamic" (TF2
     # LossScaleOptimizer semantics); only meaningful for fp16 parity
     loss_scale: Optional[Any] = None
-    enable_xla: bool = True             # --enable_xla: always-on under JAX; kept as no-op shim
-    all_reduce_alg: Optional[str] = None  # --all_reduce_alg (cifar_main.py:104) — advisory on TPU
-    num_packs: int = 1                  # --num_packs gradient packing — XLA fuses; advisory
+    enable_xla: bool = True             # --enable_xla: always-on under JAX  # dtflint: disable=flag-dead (declared reference-parity no-op: XLA is unconditional under jax)
+    all_reduce_alg: Optional[str] = None  # --all_reduce_alg (cifar_main.py:104)  # dtflint: disable=flag-dead (declared reference-parity no-op: XLA picks the collective on TPU)
+    num_packs: int = 1                  # --num_packs gradient packing  # dtflint: disable=flag-dead (declared reference-parity no-op: XLA fuses collectives)
     datasets_num_private_threads: Optional[int] = None  # input pipeline threads
     # JDCT_IFAST decode in the native train pipeline: ±1-2 LSB vs the
     # default ISLOW (augmentation-noise territory), measurably faster —
@@ -105,9 +105,9 @@ class Config:
     # bit-identical by construction.
     input_cache_dir: str = ""
     input_cache_limit_mb: int = 0       # per-shard cache byte bound; 0 = unbounded
-    per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)
-    tf_gpu_thread_mode: Optional[str] = None  # no-op compat
-    batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)
+    per_gpu_thread_count: int = 0       # no-op compat (common.py:143-166 is CUDA-only)  # dtflint: disable=flag-dead (declared no-op: CUDA-only knob, kept for reference CLI parity)
+    tf_gpu_thread_mode: Optional[str] = None  # no-op compat  # dtflint: disable=flag-dead (declared no-op: CUDA-only knob, kept for reference CLI parity)
+    batchnorm_spatial_persistent: bool = False  # no-op compat (cuDNN-only, common.py:368-377)  # dtflint: disable=flag-dead (declared no-op: cuDNN-only knob, kept for reference CLI parity)
 
     # --- image / data ---
     # --data_format (reference resnet_cifar_main.py:94-98): channels_first
@@ -122,10 +122,10 @@ class Config:
     # purity).  Training always drops the remainder for static shapes
     # (imagenet_main.py:143-145 XLA parity).
     drop_remainder: bool = False
-    image_bytes_as_serving_input: bool = False  # compat
+    image_bytes_as_serving_input: bool = False  # compat  # dtflint: disable=flag-dead (declared no-op: TF serving-signature knob with no orbax analog; kept for reference CLI parity)
 
     # --- keras-flags extras (common.py:248-309) ---
-    enable_eager: bool = False          # no-op: JAX is eager outside jit by construction
+    enable_eager: bool = False          # no-op: JAX is eager outside jit by construction  # dtflint: disable=flag-dead (declared no-op by construction; kept for reference CLI parity)
     skip_eval: bool = False             # --skip_eval
     eval_only: bool = False             # evaluate (a restored checkpoint) and exit
     use_trivial_model: bool = False     # --use_trivial_model (imagenet_main.py:189-191)

@@ -38,6 +38,7 @@ import logging
 import multiprocessing as mp
 import os
 import queue as queue_mod
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -123,7 +124,17 @@ class ServiceStream:
     same stream, no spawn cost — the right default for tests and
     single-core hosts.  ``num_workers >= 1`` spawns worker processes,
     each owning the static shard slice ``shards[w::num_workers]``.
+
+    LOCK DISCIPLINE: the stream has ONE consumer thread by contract
+    (positions/buffers are unguarded single-thread state), but
+    ``close()`` is re-entrant from elsewhere — the atexit hook, a
+    supervisor's teardown racing the consumer — so the closed latch is
+    guarded by ``_close_lock`` (declared below, enforced by
+    tools/dtflint lock-guard): the close-once check-and-set must not
+    race a second closer into double-terminating workers mid-join.
     """
+
+    _GUARDED_BY = {"_closed": "_close_lock"}
 
     MAX_RESPAWNS = 8
     GET_TIMEOUT_S = 0.5
@@ -158,6 +169,7 @@ class ServiceStream:
             process_id=int(process_id), process_count=int(process_count),
             wire=wire, cache_dir=cache_dir,
             cache_limit_bytes=int(cache_limit_bytes))
+        self._close_lock = threading.Lock()
         self._closed = False
         self.respawns = 0
         # obs wiring (default registry unless a bench injects its own)
@@ -289,6 +301,9 @@ class ServiceStream:
         return self
 
     def __next__(self):
+        # dtflint: disable=lock-guard (monotonic latch: a racy read
+        # costs at most one extra batch before StopIteration; taking
+        # _close_lock per batch would put a lock on the data hot path)
         if self._closed:
             raise StopIteration
         n = self._n
@@ -341,9 +356,10 @@ class ServiceStream:
         return hits / lookups if lookups else 0.0
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         if self.num_workers == 0:
             for r in self._readers.values():
                 r.close()

@@ -1,0 +1,93 @@
+"""The trace vocabulary — single source of truth for event/anomaly
+kinds.
+
+Every record kind the subsystems emit through :mod:`dtf_tpu.obs.trace`
+is registered HERE, and only here.  Two consumers enforce closure in
+both directions:
+
+  - ``cli/trace_main.py`` validates ``--allow <kind>`` arguments
+    against this registry (a typo'd --allow that silently tolerates
+    nothing is exactly the bug an expected-anomaly list invites);
+  - ``tools/dtflint`` (rule ``trace-unregistered`` /
+    ``trace-unemitted``) statically checks that every
+    ``trace.event("...")`` / ``trace.anomaly("...")`` call site in the
+    tree names a registered kind, AND that every registered kind is
+    emitted somewhere — a registry entry nothing produces is dead
+    vocabulary, an emission nothing registers is invisible to
+    ``--allow`` and to operators reading the docs.
+
+Keep the module dependency-free (no jax, no dtf_tpu imports): the
+linter and trace_main both need it importable in a cold process.
+"""
+
+from __future__ import annotations
+
+#: anomaly kinds the subsystems emit (docs for --allow; unknown kinds
+#: only warn at trace_main — forward compatibility beats a stale
+#: registry — but dtflint FAILS on an unregistered emission, so the
+#: registry cannot rot while CI runs)
+KNOWN_ANOMALY_KINDS = (
+    "nan_loss", "step_time_regression", "reader_lag", "serve_shed",
+    "ckpt_integrity", "injected_fault",
+    # serving replica tier (dtf_tpu/serve/router.py)
+    "router_shed", "replica_lost", "replica_give_up",
+    "redispatch_divergence", "router_deadline", "mixed_model",
+    # zero-downtime rollout (dtf_tpu/serve/rollout.py): the canary
+    # gate's verdicts and the rollback record
+    "canary_divergence", "rollout_rollback", "rollout_rollback_failed",
+)
+
+#: event kinds of the run/request-timeline / ledger / profiler layer —
+#: never anomalies, but part of the vocabulary the --allow typo check
+#: validates against: `--allow serve_retire` is a harmless no-op on a
+#: known name, while `--allow serve_retier` still warns loudly
+KNOWN_EVENT_KINDS = (
+    # tracer lifecycle (obs/trace.py stamps one per stream)
+    "trace_start",
+    # train loop (train/loop.py) + preemption (train/preemption.py)
+    "train_loss", "train_end", "epoch_end", "preempted",
+    # watchdog heartbeat records (obs/watchdog.py)
+    "heartbeat",
+    # async-PS client reconnect (parallel/ps.py)
+    "ps_reconnect",
+    # data-service supervision (data/service/pool.py)
+    "reader_respawn",
+    # request-scoped distributed tracing (router + serve engine)
+    "router_submit", "router_dispatch", "router_requeue",
+    "router_first_token", "router_complete", "router_hedge",
+    "serve_submit", "serve_admit", "serve_retire", "serve_cancelled",
+    # replica-tier supervision (serve/router.py)
+    "replica_registered", "replica_respawn",
+    # rollout lifecycle (serve/rollout.py + the router's rollout
+    # control surface)
+    "rollout_phase", "replica_drain", "replica_replaced",
+    "canary_mirror", "canary_compare", "canary_drop", "prefix_rehome",
+    # MFU/cost ledger (obs/ledger.py)
+    "ledger_exec", "ledger_summary",
+    # --profile_steps output-path marker (train/loop.py)
+    "profiler_trace",
+)
+
+#: raw chaos kinds — the ``fault_kind`` attr of ``injected_fault``
+#: records, never record names themselves.  Accepted by trace_main's
+#: --allow typo check (so `--allow replica_kill`-style near-misses
+#: warn rather than pass) and cross-checked by dtflint against
+#: dtf_tpu/chaos KINDS, but exempt from the emitted-somewhere rule.
+CHAOS_FAULT_KINDS = (
+    "crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
+    "reader_crash", "replica_kill", "net_partition", "slow_replica",
+    "rollout_kill",
+)
+
+#: metric-name grammar: <subsystem>_<name>[_<unit-ish suffix>], where
+#: the leading segment must be one of these subsystem prefixes
+#: (dtflint rule ``metric-grammar``)
+METRIC_SUBSYSTEMS = ("data", "ps", "router", "serve", "plan", "train",
+                     "ledger")
+
+
+def allowable_kinds() -> frozenset:
+    """Every name ``trace_main --allow`` accepts without a typo
+    warning."""
+    return frozenset(KNOWN_ANOMALY_KINDS) | frozenset(KNOWN_EVENT_KINDS) \
+        | frozenset(CHAOS_FAULT_KINDS)

@@ -488,7 +488,21 @@ class ServeEngine:
     Beating from the engine loop (not a side thread) is the point: a
     deadlocked engine thread stops beating, which is exactly the
     signal a health checker needs (the chatty-deadlock case a log- or
-    thread-alive check misses)."""
+    thread-alive check misses).
+
+    LOCK DISCIPLINE: ``_cond`` guards the submit-side state shared
+    between client threads and the engine thread — declared in
+    ``_GUARDED_BY`` and enforced statically by tools/dtflint (rule
+    lock-guard).  NOT guarded, deliberately: ``_slots`` and ``_cache``
+    are ENGINE-THREAD state (only ``_loop_body``/``_step``/``_admit``/
+    ``_retire`` touch them — single-writer by construction), ``_stop``
+    is a threading.Event, and ``completed`` is append-only from the
+    engine thread with len() reads elsewhere (GIL-atomic)."""
+
+    _GUARDED_BY = {
+        "_pending": "_cond", "_draining": "_cond",
+        "_ewma_latency": "_cond",
+    }
 
     def __init__(self, model, params, *, max_batch: int = 8,
                  max_seq_len: Optional[int] = None,
@@ -871,7 +885,8 @@ class ServeEngine:
                                 self._m_prefix_hits.inc(len(shared))
                             grant = (pages, shared, cow)
                         admitted.append((i, self._pending.pop(0), grant))
-                self._m_queue_depth.set(len(self._pending))
+                pending_depth = len(self._pending)
+                self._m_queue_depth.set(pending_depth)
             if self._stop.is_set() and not any(
                     s is not None for s in self._slots) and not admitted:
                 return
@@ -922,7 +937,9 @@ class ServeEngine:
                 self._m_shared.set(self.pool.shared_refs)
             if active:
                 self._m_occ_sampled.observe(active / self.max_batch)
-                self._m_queue_sampled.observe(len(self._pending))
+                # pending_depth was read under the lock above — the
+                # list mutates under _cond, so len() here would race
+                self._m_queue_sampled.observe(pending_depth)
                 if self.paged:
                     self._m_pages_sampled.observe(self.pool.used_pages)
             if decoding:
@@ -1154,6 +1171,9 @@ class ServeEngine:
             out, self._cache, _ = self.decoder.decode_step(
                 self._cache, tokens, index, temps, seeds=seeds,
                 block_tables=tables)
+            # dtflint: sync-point (the EOS/budget check needs the
+            # sampled tokens on the host; the MFU ledger's
+            # serve_decode_step wall time is honest BECAUSE this syncs)
             out = np.asarray(out)
         step_dt = time.perf_counter() - now
         self._m_step_time.observe(step_dt)
@@ -1251,20 +1271,22 @@ class ServeEngine:
                         tokens=len(slot.tokens),
                         latency_s=result.latency_s,
                         **_tctx(req.trace_id, req.trace_parent))
-        self._ewma_latency = (0.8 * self._ewma_latency
-                              + 0.2 * result.latency_s)
         self._m_completed.inc()
         self._m_latency.observe(result.latency_s)
         self._m_queue_wait.observe(result.queue_wait_s)
         self.completed.append(result)
         slot.handle._deliver(result)
         with self._cond:
+            # under the lock: submit's retry_after estimate reads it
+            self._ewma_latency = (0.8 * self._ewma_latency
+                                  + 0.2 * result.latency_s)
             self._cond.notify_all()
 
     # -- lifecycle -----------------------------------------------------
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._cond:
+            return self._draining
 
     def begin_drain(self) -> None:
         """Graceful-shutdown phase 1 (called from the SIGTERM handler,
@@ -1275,7 +1297,11 @@ class ServeEngine:
         ``stop(drain=True)`` to wait them out and join the engine
         thread — then exit 0: a drained process is a CLEAN exit, not a
         casualty."""
-        self._draining = True  # atomic under the GIL; read under _cond
+        # dtflint: disable=lock-guard (SIGTERM-handler path: taking
+        # _cond here could deadlock against the interrupted frame; the
+        # store is GIL-atomic and monotonic, readers see it at their
+        # next lock acquisition)
+        self._draining = True
         if self._cond.acquire(blocking=False):  # best-effort wake
             try:
                 self._cond.notify_all()

@@ -111,7 +111,15 @@ class ReplicaServer:
     ``result(timeout)`` → object with ``.tokens``/``.cancelled``),
     ``begin_drain()`` and ``outstanding``;
     :class:`~dtf_tpu.serve.engine.ServeEngine` satisfies it, and the
-    router tests use a jax-free fake."""
+    router tests use a jax-free fake.
+
+    LOCK DISCIPLINE: ``_conns`` is shared by the accept loop, every
+    per-connection thread's teardown, and ``stop()`` — guarded by
+    ``_lock`` (declared below, enforced by tools/dtflint lock-guard):
+    an unguarded ``list.remove`` racing another teardown throws
+    ValueError into the connection thread's finally block."""
+
+    _GUARDED_BY = {"_conns": "_lock"}
 
     def __init__(self, engine, replica_id: int, rendezvous_dir: str,
                  host: str = "127.0.0.1", port: int = 0,
@@ -133,6 +141,7 @@ class ReplicaServer:
             "127.0.0.1" if host in ("", "0.0.0.0") else host)
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
         self._conns: list = []
 
     # -- rendezvous ----------------------------------------------------
@@ -179,7 +188,9 @@ class ReplicaServer:
             self._listener.close()
         except OSError:
             pass
-        for conn in list(self._conns):
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
             try:
                 conn.close()
             except OSError:
@@ -203,7 +214,8 @@ class ReplicaServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            self._conns.append(conn)
+            with self._lock:
+                self._conns.append(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True,
                              name=f"replica{self.replica_id}-conn").start()
@@ -272,8 +284,9 @@ class ReplicaServer:
                 conn.close()
             except OSError:
                 pass
-            if conn in self._conns:
-                self._conns.remove(conn)
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
 
     def _stats(self) -> dict:
         out = {"op": "stats", "replica": self.replica_id,

@@ -158,7 +158,16 @@ class RolloutController:
         needs; ``mirror_fraction`` — the slice of live greedy traffic
         mirrored; ``max_divergence`` — gate threshold on the diverged/
         compared rate (0.0 = token-exact, the default).
+
+    LOCK DISCIPLINE: the controller reaches into the router's replica
+    state (fleet-stability checks); every such touch happens under the
+    ROUTER's ``_mu`` — declared here so tools/dtflint's lock-guard
+    rule enforces the cross-object contract (the with-block's base may
+    be any alias of the router: ``with r._mu`` / ``with
+    self.router._mu`` both satisfy it).
     """
+
+    _GUARDED_BY = {"_replicas": "_mu"}
 
     def __init__(self, router, new_checkpoint: str, *,
                  old_checkpoint: str = "",

@@ -291,7 +291,28 @@ class Router:
     means the replicas are managed externally (tests, or an operator
     supervising them separately) — the router then only connects,
     probes, and fails over, and ``kill_hook`` (tests) stands in for
-    SIGKILL when chaos wants a replica dead."""
+    SIGKILL when chaos wants a replica dead.
+
+    LOCK DISCIPLINE: ``_mu`` guards every piece of routing state the
+    dispatcher, prober, reader threads and client callers share —
+    declared below in ``_GUARDED_BY`` and ENFORCED STATICALLY by
+    ``tools/dtflint`` (rule lock-guard): any touch of a guarded
+    attribute outside ``with self._mu`` (or a ``*_locked`` method,
+    which asserts its caller holds the lock) fails CI.  NOT guarded,
+    deliberately: ``_replicas`` (the list itself is fixed at
+    construction; per-replica fields mutate under ``_mu`` through the
+    ``*_locked`` paths), ``_stopping``/``_started``/``_draining``-free
+    latches read by the loops (``_stopping`` is a monotonic bool whose
+    racy read only costs one extra loop tick), and the metrics objects
+    (internally consistent counters)."""
+
+    _GUARDED_BY = {
+        "_queue": "_mu", "_live": "_mu", "_outstanding": "_mu",
+        "_ids": "_mu", "_dispatch_seq": "_mu", "_prefix_owner": "_mu",
+        "_shadows": "_mu", "_shadow_by_req": "_mu", "_mirror": "_mu",
+        "_mirror_acc": "_mu", "_stats_events": "_mu",
+        "_draining": "_mu", "_ewma_latency": "_mu",
+    }
 
     def __init__(self, num_replicas: int, rendezvous_dir: str, *,
                  spawn: Optional[Callable] = None,
@@ -483,7 +504,8 @@ class Router:
 
     def begin_drain(self) -> None:
         """Stop admitting; queued + in-flight traffic still resolves."""
-        self._draining = True
+        with self._mu:
+            self._draining = True
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
         if drain:
@@ -931,14 +953,15 @@ class Router:
         op = msg.get("op")
         if op == "stats":
             tag = msg.get("tag", "")
-            ev = self._stats_events.pop((rep.id, tag), None)
-            if ev is not None:
-                # only a live waiter stores the snapshot (and pops it
-                # on read): an operator polling stats every few
-                # seconds must not grow this dict for the router's
-                # lifetime
-                rep.last_stats[tag] = msg
-                ev.set()
+            with self._mu:
+                ev = self._stats_events.pop((rep.id, tag), None)
+                if ev is not None:
+                    # only a live waiter stores the snapshot (and pops
+                    # it on read): an operator polling stats every few
+                    # seconds must not grow this dict for the router's
+                    # lifetime
+                    rep.last_stats[tag] = msg
+                    ev.set()
             return
         with self._mu:
             wire_id = msg.get("id")
@@ -1250,9 +1273,9 @@ class Router:
             time.sleep(self.probe_interval_s)
             if self._stopping:
                 return
-            traffic = self._dispatch_seq > 0
             now = time.monotonic()
             with self._mu:
+                traffic = self._dispatch_seq > 0
                 for rep in self._replicas:
                     if rep.gave_up:
                         continue
@@ -1551,7 +1574,14 @@ class Router:
                 self._stats_events.pop((rep.id, tag), None)
                 return None
         if not ev.wait(timeout):
-            self._stats_events.pop((rep.id, tag), None)
+            with self._mu:
+                self._stats_events.pop((rep.id, tag), None)
+                # the reply may have raced the timeout: _on_msg popped
+                # the event and stored the snapshot before this lock
+                # acquisition — drop it, or every timed-out poll
+                # leaves one permanent last_stats entry (tags are
+                # unique per call)
+                rep.last_stats.pop(tag, None)
             return None
         return rep.last_stats.pop(tag, None)
 

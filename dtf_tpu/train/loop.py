@@ -814,6 +814,7 @@ class Trainer:
         """Runs training; returns (state, stats-dict) where the stats dict
         is key-compatible with common.build_stats output."""
         cfg = self.cfg
+        # dtflint: sync-point (one-time resume-position read, pre-loop)
         resumed_step = int(jax.device_get(state.step))
         time_cb = TimeHistory(self.global_batch, cfg.log_steps,
                               initial_global_step=resumed_step)
@@ -932,6 +933,8 @@ class Trainer:
                     if global_step % cfg.log_steps == 0:
                         # device_get (host copy): block_until_ready can
                         # return early on some remote platforms
+                        # dtflint: sync-point (log-cadence host copy —
+                        # the ledger's log_window wall time accounts it)
                         loss_val = jax.device_get(metrics["loss"])
                         nan_guard.check(global_step, float(loss_val))
                         # the loss trajectory record: Python floats
@@ -990,6 +993,8 @@ class Trainer:
                         raise preemption.Preempted(global_step, signum)
                 # epoch end: materialize the last step's metrics (keras history
                 # records per-epoch training metrics)
+                # dtflint: sync-point (epoch-boundary metrics copy,
+                # outside the step-time guard's measured window)
                 m = jax.device_get(metrics)
                 nan_guard.check(global_step, float(m["loss"]))
                 trace.event("epoch_end", epoch=epoch, step=global_step,
@@ -1063,6 +1068,7 @@ class Trainer:
         if metrics is not None:
             # host copy: the only reliable completion sync on platforms
             # where block_until_ready returns early
+            # dtflint: sync-point (final completion barrier, post-loop)
             jax.device_get(metrics["loss"])
         log.info("train wall time: %.1fs (%d steps)",
                  time.time() - t0, global_step)

@@ -23,7 +23,78 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import sys  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
+
+# --- thread sanitizer: record where every NON-DAEMON thread started -------
+# The serving tier spawns a lot of threads (router dispatcher/prober/
+# readers, replica accept/conn/writer/waiter, metrics servers).  All of
+# them are daemons BY CONTRACT — a non-daemon thread that outlives its
+# test would hang interpreter shutdown and serialize the whole suite
+# behind a leak nobody can attribute.  The sanitizer fixture below
+# enforces the contract after EVERY test; this start() wrapper is what
+# lets it report the leaker's creation stack instead of just a name.
+# Only non-daemon threads are recorded (the daemon flag is final by
+# start() time), and only cheap (file, line, function) tuples — a
+# format_stack here measurably slows thread-storm tests (the prom
+# endpoint test starts hundreds of handler threads).
+
+_orig_thread_start = threading.Thread.start
+
+
+def _recording_start(self, *args, **kwargs):
+    if not self.daemon and not hasattr(self, "_dtf_started_at"):
+        frames, f = [], sys._getframe(1)
+        while f is not None and len(frames) < 10:
+            frames.append((f.f_code.co_filename, f.f_lineno,
+                           f.f_code.co_name))
+            f = f.f_back
+        self._dtf_started_at = frames
+    return _orig_thread_start(self, *args, **kwargs)
+
+
+threading.Thread.start = _recording_start
+
+
+def _format_creation_stack(thread) -> str:
+    frames = getattr(thread, "_dtf_started_at", None)
+    if not frames:
+        return "    <creation stack not recorded>\n"
+    return "".join(f"    {fn}:{ln} in {name}\n"
+                   for fn, ln, name in frames)
+
+
+@pytest.fixture(autouse=True)
+def _thread_sanitizer():
+    """After each test: no leaked non-daemon threads.
+
+    Leaked DAEMON threads are tolerated (engines/routers under test
+    run daemons that die with the process — the watchdog for those is
+    the wall-clock budget), but a NON-daemon leak fails the leaking
+    test with the thread's creation stack, while the culprit is still
+    on screen."""
+    import time as _time
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon and t not in before]
+
+    threads = leaked()
+    deadline = _time.monotonic() + 2.0
+    while threads and _time.monotonic() < deadline:
+        _time.sleep(0.05)   # grace: teardown joins may still be racing
+        threads = leaked()
+    if threads:
+        lines = [f"  {t.name} (alive, daemon=False), started at:\n"
+                 f"{_format_creation_stack(t)}" for t in threads]
+        pytest.fail(
+            "leaked non-daemon thread(s) — they would hang interpreter "
+            "shutdown; join them in the test/fixture teardown or mark "
+            "them daemon:\n" + "\n".join(lines), pytrace=False)
 
 
 def pytest_configure(config):

@@ -1,0 +1,5 @@
+import sys
+
+from tools.dtflint import main
+
+sys.exit(main())

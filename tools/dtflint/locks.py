@@ -1,0 +1,150 @@
+"""lock-guard — a static race detector for the thread-heavy tiers.
+
+A class declares which of its attributes a lock guards::
+
+    class Router:
+        _GUARDED_BY = {"_queue": "_mu", "_live": "_mu"}
+
+The contract checked here: within the declaring class's methods, every
+read or write of a guarded attribute must be LEXICALLY inside a
+``with <anything>.<lockname>:`` block (any base expression — ``with
+self._mu`` and ``with r._mu`` both satisfy a ``_mu`` guard, which is
+what lets a collaborator module like serve/rollout.py declare guards
+over the router state it reaches into), or live in a method the class
+marks as called-with-the-lock-held:
+
+  - a name ending in ``_locked`` (the repo's existing convention:
+    ``_dispatch_locked``, ``_resolve_locked``, ...), or
+  - a ``# dtflint: called-locked (reason)`` annotation on the def.
+
+``__init__``/``__del__`` are exempt (the object is not shared yet /
+anymore).  This is a LEXICAL check, deliberately: it cannot prove the
+absence of races (aliasing, lock identity, closures), but it pins the
+discipline the code already follows — and the historical bug class it
+targets (an attribute touch added outside the lock during a refactor,
+visible only in 16-rank logs) is exactly a lexical mistake.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.dtflint import Context, Finding, Source
+
+EXEMPT_METHODS = ("__init__", "__del__", "__post_init__")
+
+
+def _guard_decl(cls: ast.ClassDef):
+    """The ``_GUARDED_BY`` dict literal of a class, if declared.
+    Returns (mapping, lineno) or (None, assignment-line-or-0)."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id == "_GUARDED_BY":
+            if isinstance(stmt.value, ast.Dict) and all(
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    for k in stmt.value.keys) and all(
+                    isinstance(v, ast.Constant) and isinstance(v.value, str)
+                    for v in stmt.value.values):
+                return ({k.value: v.value for k, v in
+                         zip(stmt.value.keys, stmt.value.values)},
+                        stmt.lineno)
+            return (None, stmt.lineno)
+    return (None, 0)
+
+
+def _with_locks(node: ast.With) -> List[str]:
+    """Lock attribute names this with-statement acquires (the final
+    attribute of each context expression: ``with self._mu:`` -> _mu;
+    ``with self._cond:`` -> _cond).  Bare-name context managers
+    (``with lock:``) count under their name too."""
+    out = []
+    for item in node.items:
+        expr = item.context_expr
+        # with x.lock.acquire()? not a pattern here; unwrap calls like
+        # ``with self._mu:`` (Attribute) and ``with lock:`` (Name)
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            out.append(expr.attr)
+        elif isinstance(expr, ast.Name):
+            out.append(expr.id)
+    return out
+
+
+class _MethodChecker(ast.NodeVisitor):
+    def __init__(self, src: Source, cls: str, method: str,
+                 guards: Dict[str, str]):
+        self.src = src
+        self.cls = cls
+        self.method = method
+        self.guards = guards
+        self.held: List[str] = []
+        self.findings: List[Finding] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        # context expressions are evaluated BEFORE the lock is
+        # acquired: a guarded touch inside one (e.g. ``with
+        # self._locks_for(self._queue[0]):``) is checked against the
+        # OUTER held state.  Lock attributes themselves are never
+        # guard keys, so plain ``with self._mu:`` stays silent.
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        locks = _with_locks(node)
+        self.held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(locks):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node) -> None:
+        # a closure defined here runs LATER, possibly without the
+        # lock: check its body as if nothing were held
+        saved, self.held = self.held, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        lock = self.guards.get(node.attr)
+        if lock is not None and lock not in self.held:
+            self.findings.append(Finding(
+                "lock-guard", self.src.path, node.lineno,
+                f"'{node.attr}' touched outside 'with ...{lock}' in "
+                f"{self.cls}.{self.method} (declared in _GUARDED_BY)"))
+        self.generic_visit(node)
+
+
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in ctx.sources:
+        for cls in [n for n in ast.walk(src.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            guards, decl_line = _guard_decl(cls)
+            if guards is None:
+                if decl_line:
+                    findings.append(Finding(
+                        "lock-decl", src.path, decl_line,
+                        f"_GUARDED_BY of {cls.name} must be a literal "
+                        f"{{'attr': 'lock'}} dict of strings"))
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if meth.name in EXEMPT_METHODS \
+                        or meth.name.endswith("_locked") \
+                        or src.is_called_locked(meth.lineno):
+                    continue
+                mc = _MethodChecker(src, cls.name, meth.name, guards)
+                for stmt in meth.body:
+                    mc.visit(stmt)
+                findings.extend(mc.findings)
+    return findings

@@ -1,0 +1,266 @@
+"""Vocabulary-closure rules.
+
+trace-unregistered / trace-unemitted — every ``trace.event("k")`` /
+``trace.anomaly("k")`` call site (and every literal record dict with
+``"kind": "event"|"anomaly"``) must name a kind registered in
+``dtf_tpu/obs/vocab.py``, and every registered kind must be emitted by
+some call site.  Closure in both directions keeps ``--allow`` and the
+operator docs honest: an unregistered emission is invisible to the
+allow-list's typo check; a registered-but-never-emitted kind is dead
+vocabulary that misleads anyone reading the registry.
+
+metric-grammar / metric-dup — metric registrations
+(``registry.gauge/counter/histogram("name", unit=...)``) must follow
+the ``<subsystem>_<snake_case>`` grammar with a known subsystem
+prefix, and one name must mean ONE thing: registering the same name as
+two different metric types, or with two different units, is a
+collision (dashboards would silently average apples into oranges).
+
+chaos-probe — every kind in the chaos grammar (``chaos.KINDS``) must
+map to an injector probe point that some non-chaos module actually
+calls, and must appear in vocab's CHAOS_FAULT_KINDS (the --allow
+alias list): a fault spec that parses but never fires invalidates the
+whole experiment — the reference repo's flag-rot bug class, replayed
+on the chaos surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.dtflint import Context, Finding, Source
+
+#: chaos grammar kind -> the Injector probe method instrumented code
+#: must call (module-level wrapper of the same name)
+CHAOS_PROBES = {
+    "crash": "step",
+    "sigterm": "step",
+    "heartbeat_stall": "heartbeat_stalled",
+    "ps_drop": "ps_drop",
+    "ckpt_truncate": "ckpt_truncate",
+    "reader_crash": "reader_crash",
+    "replica_kill": "replica_kill",
+    "net_partition": "net_partition",
+    "slow_replica": "slow_replica",
+    "rollout_kill": "rollout_kill",
+}
+
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
+
+
+def _module_tuple(path: str, name: str) -> Tuple[Tuple[str, ...], int]:
+    """(string-tuple assigned to module-level ``name``, its line)."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            vals = tuple(e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+            return vals, node.lineno
+    return (), 0
+
+
+def _emissions(src: Source) -> List[Tuple[str, str, int]]:
+    """[(kind_name, record_kind, line)] for every literal trace
+    emission in one file: trace.event("x")/trace.anomaly("x") calls
+    plus literal record dicts carrying "kind"/"name"."""
+    out = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("event", "anomaly") \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.func.attr, node.lineno))
+        elif isinstance(node, ast.Dict):
+            keys = {k.value: v for k, v in zip(node.keys, node.values)
+                    if isinstance(k, ast.Constant)}
+            kind = keys.get("kind")
+            name = keys.get("name")
+            if isinstance(kind, ast.Constant) \
+                    and kind.value in ("event", "anomaly") \
+                    and isinstance(name, ast.Constant) \
+                    and isinstance(name.value, str):
+                out.append((name.value, kind.value, node.lineno))
+    return out
+
+
+def _metric_regs(src: Source):
+    """[(name_or_None, type, unit_or_None, line, prefix_of_fstring)]"""
+    out = []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("gauge", "counter", "histogram")
+                and node.args):
+            continue
+        unit = None
+        for kw in node.keywords:
+            if kw.arg == "unit" and isinstance(kw.value, ast.Constant):
+                unit = kw.value.value
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((arg.value, node.func.attr, unit, node.lineno,
+                        None))
+        elif isinstance(arg, ast.JoinedStr) and arg.values and \
+                isinstance(arg.values[0], ast.Constant):
+            out.append((None, node.func.attr, unit, node.lineno,
+                        str(arg.values[0].value)))
+    return out
+
+
+def check(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(_check_trace_closure(ctx))
+    findings.extend(_check_metrics(ctx))
+    findings.extend(_check_chaos(ctx))
+    return findings
+
+
+def _check_trace_closure(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        anomalies, a_line = _module_tuple(ctx.vocab_path,
+                                          "KNOWN_ANOMALY_KINDS")
+        events, e_line = _module_tuple(ctx.vocab_path,
+                                       "KNOWN_EVENT_KINDS")
+        chaos_alias, _ = _module_tuple(ctx.vocab_path,
+                                       "CHAOS_FAULT_KINDS")
+    except (OSError, SyntaxError):
+        return findings  # fixture tree without a vocab — nothing to do
+    vocab_rel = None
+    emitted: Dict[str, str] = {}   # kind -> "event"|"anomaly"
+    for src in ctx.sources:
+        if src.abspath == ctx.vocab_path:
+            vocab_rel = src.path
+            continue
+        for name, kind, line in _emissions(src):
+            emitted.setdefault(name, kind)
+            registry = anomalies if kind == "anomaly" else events
+            if name not in registry:
+                findings.append(Finding(
+                    "trace-unregistered", src.path, line,
+                    f"{kind} kind '{name}' is not registered in "
+                    f"obs/vocab.py KNOWN_"
+                    f"{'ANOMALY' if kind == 'anomaly' else 'EVENT'}"
+                    f"_KINDS — register it (or fix the name)"))
+    if vocab_rel is not None:
+        for name in anomalies:
+            if name not in emitted:
+                findings.append(Finding(
+                    "trace-unemitted", vocab_rel, a_line,
+                    f"anomaly kind '{name}' is registered but no "
+                    f"code emits it — dead vocabulary"))
+        for name in events:
+            if name not in emitted:
+                findings.append(Finding(
+                    "trace-unemitted", vocab_rel, e_line,
+                    f"event kind '{name}' is registered but no code "
+                    f"emits it — dead vocabulary"))
+        dual = set(anomalies) & set(events)
+        for name in sorted(dual):
+            findings.append(Finding(
+                "trace-unregistered", vocab_rel, a_line,
+                f"'{name}' is registered as BOTH anomaly and event"))
+    return findings
+
+
+def _check_metrics(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        prefixes, _ = _module_tuple(ctx.vocab_path, "METRIC_SUBSYSTEMS")
+    except (OSError, SyntaxError):
+        prefixes = ()
+    if not prefixes:
+        prefixes = ("data", "ps", "router", "serve", "plan", "train")
+    seen: Dict[str, Tuple[str, Optional[str], str, int]] = {}
+    for src in ctx.sources:
+        if src.path.startswith("dtf_tpu/obs/registry"):
+            continue  # the registry's own constructors, not usages
+        for name, mtype, unit, line, fprefix in _metric_regs(src):
+            probe = name if name is not None else fprefix
+            if probe is None:
+                continue
+            if name is not None and not _METRIC_NAME_RE.match(name):
+                findings.append(Finding(
+                    "metric-grammar", src.path, line,
+                    f"metric name '{name}' is not "
+                    f"<subsystem>_<snake_case>"))
+                continue
+            if not any(probe == p or probe.startswith(p + "_")
+                       for p in prefixes):
+                findings.append(Finding(
+                    "metric-grammar", src.path, line,
+                    f"metric name '{probe}…' does not start with a "
+                    f"known subsystem prefix {tuple(prefixes)} — "
+                    f"extend obs/vocab.py METRIC_SUBSYSTEMS if this "
+                    f"is a new subsystem"))
+            if name is None:
+                continue
+            prior = seen.get(name)
+            if prior is None:
+                seen[name] = (mtype, unit, src.path, line)
+            elif prior[0] != mtype or prior[1] != unit:
+                findings.append(Finding(
+                    "metric-dup", src.path, line,
+                    f"metric '{name}' re-registered as {mtype}/"
+                    f"unit={unit!r} but {prior[2]} declares "
+                    f"{prior[0]}/unit={prior[1]!r} — one name must "
+                    f"mean one thing"))
+    return findings
+
+
+def _check_chaos(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    try:
+        kinds, k_line = _module_tuple(ctx.chaos_path, "KINDS")
+    except (OSError, SyntaxError):
+        return findings
+    if not kinds:
+        return findings
+    chaos_rel = next((s.path for s in ctx.sources
+                      if s.abspath == ctx.chaos_path),
+                     "dtf_tpu/chaos/__init__.py")
+    try:
+        alias, _ = _module_tuple(ctx.vocab_path, "CHAOS_FAULT_KINDS")
+    except (OSError, SyntaxError):
+        alias = None
+    # which chaos.<probe>( calls exist OUTSIDE the chaos package
+    called = set()
+    for src in ctx.sources:
+        if src.path.startswith("dtf_tpu/chaos"):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "chaos":
+                called.add(node.func.attr)
+    for kind in kinds:
+        probe = CHAOS_PROBES.get(kind)
+        if probe is None:
+            findings.append(Finding(
+                "chaos-probe", chaos_rel, k_line,
+                f"chaos kind '{kind}' has no probe mapping in "
+                f"tools/dtflint/vocab_rules.CHAOS_PROBES — a grammar "
+                f"kind must name the injector probe that fires it"))
+        elif probe not in called:
+            findings.append(Finding(
+                "chaos-probe", chaos_rel, k_line,
+                f"chaos kind '{kind}': no module outside dtf_tpu/chaos "
+                f"calls chaos.{probe}() — the fault would parse but "
+                f"never fire"))
+        if alias is not None and kind not in alias:
+            findings.append(Finding(
+                "chaos-probe", chaos_rel, k_line,
+                f"chaos kind '{kind}' missing from obs/vocab.py "
+                f"CHAOS_FAULT_KINDS — `--allow {kind}` would warn as "
+                f"a typo"))
+    return findings

@@ -1,22 +1,16 @@
 #!/usr/bin/env python
 """Marker audit — fail when an unmarked test exceeds the time ceiling.
 
-Tier-1 runs `-m 'not slow'` under a hard wall-clock budget (ROADMAP:
-870 s on a 1-core box).  That budget only holds if every genuinely
-heavy test (multi-device compiles, e2e PS runs) carries the `slow`
-marker — and nothing enforces that by itself: a new test that compiles
-an 8-way mesh quietly adds a minute to every CI run until someone
-notices the suite timing out.
-
-This audit closes the loop.  The test session dumps per-test call
-durations to ``tests/.last_durations.json`` (conftest hook); run the
-suite, then:
+THIN SHIM: the logic moved into the project-wide static-analysis suite
+(tools/dtflint, rule ``test-marker``) so CI runs ONE analysis
+entrypoint; this CLI remains for muscle memory and scripts.  Semantics
+are unchanged: tier-1 runs `-m 'not slow'` under a hard wall-clock
+budget (ROADMAP: 870 s), which only holds if every genuinely heavy
+test carries the `slow` marker.  The conftest hook dumps per-test call
+durations to ``tests/.last_durations.json``; exit 1 (listing
+offenders) when any UNMARKED test took longer than the ceiling.
 
     python tools/marker_audit.py [--ceiling 20] [--path tests/.last_durations.json]
-
-Exit 1 (listing offenders) when any test WITHOUT the `slow` marker took
-longer than the ceiling.  Marked-slow tests may take as long as they
-like — they are excluded from tier-1 by construction.
 """
 
 from __future__ import annotations
@@ -26,18 +20,13 @@ import json
 import os
 import sys
 
-DEFAULT_CEILING_S = 20.0
+# the single source of the audit logic + default ceiling
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools.dtflint.markers import DEFAULT_CEILING_S, audit  # noqa: E402
+
 DEFAULT_PATH = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tests", ".last_durations.json")
-
-
-def audit(durations: dict, ceiling_s: float) -> list:
-    """Returns [(nodeid, duration), ...] of unmarked tests over the
-    ceiling, slowest first."""
-    offenders = [(nodeid, rec["duration"])
-                 for nodeid, rec in durations.items()
-                 if not rec.get("slow") and rec["duration"] > ceiling_s]
-    return sorted(offenders, key=lambda kv: -kv[1])
 
 
 def main(argv=None) -> int:
