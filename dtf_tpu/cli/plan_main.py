@@ -62,7 +62,14 @@ _OWN_FLAGS = {
     "calibrate": (False, False),
     "calibrate_steps": (True, 8),
     "calibrate_tolerance": (True, 2.0),
+    # ZeRO-2/3 compute/comm overlap fraction the cost model credits
+    # (cost_model.DEFAULT_OVERLAP_FRAC when unset); --calibrate emits
+    # the measured run's IMPLIED fraction as plan_overlap_frac_implied
+    # — feed that back here to pin the model to this box
+    "overlap_frac": (True, None),
 }
+
+_FLOAT_FLAGS = ("calibrate_tolerance", "overlap_frac")
 
 
 def _split_args(argv):
@@ -76,7 +83,7 @@ def _split_args(argv):
             takes_value = _OWN_FLAGS[name][0]
             if takes_value:
                 raw = argv[i + 1]
-                own[name] = (float(raw) if name == "calibrate_tolerance"
+                own[name] = (float(raw) if name in _FLOAT_FLAGS
                              else raw if name == "out" else int(raw))
                 i += 2
             else:
@@ -137,7 +144,8 @@ def _check(cfg, ranked, check_top: int) -> int:
     return 1 if failures else 0
 
 
-def _calibrate(cfg, stats, mesh, plan, steps: int, tolerance: float) -> int:
+def _calibrate(cfg, stats, mesh, plan, steps: int, tolerance: float,
+               overlap_frac: float) -> int:
     """Measured smoke vs prediction.  Records, per the obs-registry
     contract: plan_predicted_step_s, plan_measured_step_s,
     plan_step_time_ratio, plan_predicted_peak_bytes,
@@ -157,7 +165,8 @@ def _calibrate(cfg, stats, mesh, plan, steps: int, tolerance: float) -> int:
 
     measured_flops = calibrate_device_flops()
     cost = predict(plan, stats, mesh, cfg.batch_size,
-                   optimizer=cfg.optimizer, device_flops=measured_flops)
+                   optimizer=cfg.optimizer, device_flops=measured_flops,
+                   overlap_frac=overlap_frac)
     # calibrating a hand-flagged config: the plan was DERIVED from the
     # plan-owned flags (plan_from_config), so reset them to defaults
     # before apply_plan writes them back — otherwise its hand-set-flag
@@ -184,6 +193,25 @@ def _calibrate(cfg, stats, mesh, plan, steps: int, tolerance: float) -> int:
     reg.gauge("plan_predicted_step_s", unit="s").set(cost.step_time_s)
     reg.gauge("plan_measured_step_s", unit="s").set(measured_step)
     reg.gauge("plan_step_time_ratio").set(ratio)
+    if plan.zero >= 2:
+        # invert the overlap term against the measurement: the
+        # overlap_frac that makes the model meet the measured step.
+        # predict() defines hidden = min(t_grad, frac · compute), so
+        # the implied fraction is measured-hidden / COMPUTE — same
+        # denominator as the flag it feeds back into (--overlap_frac)
+        t_grad = cost.breakdown.get("grad_sync_s", 0.0)
+        hidden_pred = cost.breakdown.get("hidden_comm_s", 0.0)
+        other = cost.comm_s - (t_grad - hidden_pred)
+        if t_grad > 0 and cost.compute_s > 0:
+            measured_exposed = max(0.0, measured_step - cost.compute_s
+                                   - other)
+            hidden_meas = min(max(t_grad - measured_exposed, 0.0),
+                              t_grad)
+            implied = min(hidden_meas / cost.compute_s, 1.0)
+            reg.gauge("plan_overlap_frac_implied").set(implied)
+            print(f"  overlap: modeled frac "
+                  f"{cost.breakdown.get('overlap_frac', 0.0):.2f}, "
+                  f"measured-implied {implied:.2f}")
     reg.gauge("plan_predicted_peak_bytes", unit="bytes").set(
         cost.peak_bytes)
     if measured_live:
@@ -229,26 +257,31 @@ def main(argv=None) -> int:
     from dtf_tpu.plan.mesh_spec import mesh_spec
     from dtf_tpu.plan.search import RankedPlan, ranked_artifact
 
+    from dtf_tpu.plan.cost_model import DEFAULT_OVERLAP_FRAC
+
     stats = stats_for_config(cfg)
     mesh = mesh_spec(cfg.plan_mesh)
+    overlap = (DEFAULT_OVERLAP_FRAC if own["overlap_frac"] is None
+               else float(own["overlap_frac"]))
 
     if cfg.plan and cfg.plan != "auto":
         # evaluate ONE explicit plan (still printed as a 1-row ranking)
         plan = load_plan_file(cfg.plan)
         violations = tuple(check_plan(plan, stats, mesh, cfg.batch_size))
         cost = predict(plan, stats, mesh, cfg.batch_size,
-                       optimizer=cfg.optimizer)
+                       optimizer=cfg.optimizer, overlap_frac=overlap)
         ranked = [RankedPlan(plan, cost, violations)]
     elif cfg.plan_cache:
         from dtf_tpu.plan.cache import cached_search
         ranked, hit = cached_search(cfg.plan_cache, stats, mesh,
                                     cfg.batch_size,
-                                    optimizer=cfg.optimizer)
+                                    optimizer=cfg.optimizer,
+                                    overlap_frac=overlap)
         print(f"plan cache: {'HIT — search skipped' if hit else 'miss'} "
               f"({cfg.plan_cache})")
     else:
         ranked = search(stats, mesh, cfg.batch_size,
-                        optimizer=cfg.optimizer)
+                        optimizer=cfg.optimizer, overlap_frac=overlap)
 
     feasible = sum(1 for r in ranked if r.feasible)
     print(f"{stats.model} ({stats.params / 1e6:.1f}M params"
@@ -310,7 +343,7 @@ def main(argv=None) -> int:
                 else plan_from_config(cfg, mesh.num_devices))
         rc = rc or _calibrate(cfg, stats, mesh, plan,
                               own["calibrate_steps"],
-                              own["calibrate_tolerance"])
+                              own["calibrate_tolerance"], overlap)
     return rc
 
 

@@ -108,6 +108,11 @@ def _build_parser() -> argparse.ArgumentParser:
                          "against shed rate")
     ap.add_argument("--loss_bar", type=float, default=0.01,
                     help="max shed+deadline fraction a config may lose")
+    ap.add_argument("--chip_cost_per_hour", type=float, default=0.0,
+                    help="with --chips and --slo_p99: rank the tp × "
+                         "replicas splits by $/Mtoken AT the SLO "
+                         "(fleet rate = chips × this, throughput from "
+                         "the simulator); 0 = off")
     # calibration
     ap.add_argument("--calibrate", action="store_true",
                     help="record a live traced engine run, replay it, "
@@ -183,10 +188,12 @@ def _whatifs(args, workload, profile, base, artifact) -> None:
             "evaluated": [{"replicas": r, **p.to_dict()}
                           for r, p in evaluated]}
 
+    tp_ranked = None
     if args.chips > 0:
         ranked = sm.rank_tp_vs_replicas(workload, profile, base,
                                         args.chips,
                                         loss_bar=args.loss_bar)
+        tp_ranked = ranked
         print(f"\nwhat-if: tp × replicas at {args.chips} chips")
         for i, (cfg, pred) in enumerate(ranked, start=1):
             print(f"  #{i} {cfg.describe():<40} {_fmt_pred(pred)}")
@@ -194,6 +201,34 @@ def _whatifs(args, workload, profile, base, artifact) -> None:
             "chips": args.chips,
             "ranked": [{"config": c.to_dict(), **p.to_dict()}
                        for c, p in ranked]}
+
+    if args.chip_cost_per_hour > 0:
+        if not (args.chips > 0 and args.slo_p99 > 0):
+            raise SystemExit(
+                "--chip_cost_per_hour needs --chips (the budget to "
+                "split) and --slo_p99 (the SLO the $/token ranking "
+                "holds configs to)")
+        # reuse the tp × replicas simulations above — same splits,
+        # no second trace replay
+        rows = sm.rank_cost_per_token(
+            workload, profile, base, args.chips,
+            args.chip_cost_per_hour, args.slo_p99,
+            loss_bar=args.loss_bar, evaluated=tp_ranked)
+        print(f"\nwhat-if: $/Mtoken at {args.chips} chips × "
+              f"${args.chip_cost_per_hour:g}/chip-hr, p99 <= "
+              f"{args.slo_p99:g}s")
+        for i, row in enumerate(rows, start=1):
+            verdict = ("ok" if row.meets_slo else "MISSES SLO")
+            cost = ("inf" if row.usd_per_mtoken == float("inf")
+                    else f"{row.usd_per_mtoken:8.2f}")
+            print(f"  #{i} {row.config.describe():<40} "
+                  f"${cost}/Mtok  {_fmt_pred(row.prediction)}  "
+                  f"[{verdict}]")
+        artifact["cost_per_token"] = {
+            "chips": args.chips,
+            "chip_cost_per_hour": args.chip_cost_per_hour,
+            "slo_p99_s": args.slo_p99,
+            "ranked": [r.to_dict() for r in rows]}
 
     if args.pool_sweep:
         sizes = [int(s) for s in args.pool_sweep.split(",") if s.strip()]

@@ -386,14 +386,49 @@ def _run(cfg: Config) -> dict:
                     "data": data}
         ckpt_cb = ckpt_mod.CheckpointCallback(
             cfg.model_dir, every_steps=cfg.checkpoint_steps,
-            host_state_fn=host_state_fn, keep=cfg.checkpoint_keep)
+            host_state_fn=host_state_fn, keep=cfg.checkpoint_keep,
+            # ZeRO runs save the canonical stage-0 layout (full-shaped
+            # params + optimizer state): the checkpoint is
+            # stage-portable — restore into any --zero_stage, or into
+            # serving via the bridge
+            state_transform=(trainer.canonical_state if trainer.zero
+                             else None))
         if cfg.resume:
-            # restore with the state's own per-leaf shardings (TP/EP/PP
-            # states are not replicated — a blanket replicated sharding
-            # would silently unshard them)
-            state_shardings = jax.tree_util.tree_map(
-                lambda x: x.sharding, state)
-            restored = ckpt_cb.ckpt.restore(state, sharding=state_shardings)
+            if trainer.zero:
+                # ZeRO: checkpoints hold the canonical form; restore
+                # against the stage-independent template, then place
+                # into this run's stage layout (sliced params/opt
+                # state with their shardings)
+                restored = ckpt_cb.ckpt.restore(
+                    trainer.canonical_template())
+                if restored is None and ckpt_cb.ckpt.verified_steps():
+                    # steps that VERIFY (sha256-intact) but restore
+                    # into the canonical template for none of the
+                    # candidates are a layout mismatch, not corruption
+                    # — almost certainly a pre-canonical-format
+                    # --optimizer_sharding run (sliced optimizer
+                    # state on disk).  Restarting from scratch here
+                    # would silently discard the whole run.
+                    raise ValueError(
+                        f"--resume: checkpoints under "
+                        f"{cfg.model_dir}/checkpoints pass integrity "
+                        f"verification but do not match the canonical "
+                        f"ZeRO checkpoint layout (full-shaped params + "
+                        f"optimizer state).  They likely predate the "
+                        f"stage-portable format (older "
+                        f"--optimizer_sharding runs saved sliced "
+                        f"state).  Resume them with the code revision "
+                        f"that wrote them, or restart without --resume")
+                if restored is not None:
+                    restored = trainer.staged_state(restored)
+            else:
+                # restore with the state's own per-leaf shardings
+                # (TP/EP/PP states are not replicated — a blanket
+                # replicated sharding would silently unshard them)
+                state_shardings = jax.tree_util.tree_map(
+                    lambda x: x.sharding, state)
+                restored = ckpt_cb.ckpt.restore(state,
+                                                sharding=state_shardings)
             if restored is not None:
                 state = restored
                 resumed_step = int(jax.device_get(state.step))
@@ -491,8 +526,10 @@ def _run(cfg: Config) -> dict:
 
     if export_model is not None:
         # --export_dir parity: final inference variables, written once
-        # (replicated state ⇒ the collective write is coordinator-led)
-        export_model(cfg.export_dir, state)
+        # (replicated state ⇒ the collective write is coordinator-led);
+        # ZeRO states export their canonical full-shaped params
+        export_model(cfg.export_dir, trainer.canonical_state(state)
+                     if trainer.zero else state)
 
     log.info("Run stats: %s",
              {k: v for k, v in stats.items() if k != "step_timestamp_log"})

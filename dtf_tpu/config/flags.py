@@ -238,8 +238,32 @@ class Config:
     # Training"): reduce-scatter gradients, update a 1/N parameter
     # slice per data shard with 1/N optimizer state, all-gather the
     # updated params — optimizer memory and update FLOPs drop by the
-    # data-parallel degree at equal communication volume
+    # data-parallel degree at equal communication volume.  Kept as the
+    # stage-1 shorthand; --zero_stage is the full lever
     optimizer_sharding: bool = False
+    # ZeRO stage on the data axis (train/loop.py, train/zero.py):
+    #   0 = replicated everything (plain DP)
+    #   1 = sharded optimizer state (≡ --optimizer_sharding)
+    #   2 = + sharded gradients: each microbatch's grads reduce-scatter
+    #       into 1/N slices as the backward produces them (per-leaf, so
+    #       XLA's latency-hiding scheduler overlaps the collectives
+    #       with compute); the grad-accumulation buffer shrinks by the
+    #       data-parallel degree
+    #   3 = + sharded parameters: params live as 1/N flat slices and
+    #       are all-gathered per leaf at the top of each step — a model
+    #       whose replicated state does not fit one device trains
+    # Every stage is mathematically identical to plain DP (test-pinned
+    # within the documented float tolerance); checkpoints are written
+    # in the canonical stage-0 layout, so any stage restores into any
+    # other and into serving via the bridge
+    zero_stage: int = 0
+    # measure the ZeRO collective cost (stages >= 2): time standalone
+    # reduce-scatter/all-gather probes plus a comm-stubbed twin of the
+    # compiled step, and export train_zero_*_wall_s +
+    # train_exposed_comm_frac gauges through the MFU ledger.  Costs one
+    # extra step compile — a bench/smoke lever, not a production
+    # default
+    zero_probe: bool = False
 
     # --- serving (cli/serve_main.py over dtf_tpu/serve) ---
     serve_max_batch: int = 8            # decode slots = max concurrent sequences
@@ -467,6 +491,17 @@ class Config:
                     raise ValueError(
                         f"loss_scale must be a positive finite number, "
                         f"got {val}")
+        if self.zero_stage not in (0, 1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 0, 1, 2 or 3, got {self.zero_stage}")
+        if self.optimizer_sharding and self.zero_stage >= 2:
+            raise ValueError(
+                "--optimizer_sharding is the ZeRO stage-1 shorthand and "
+                "contradicts --zero_stage >= 2 — pass only --zero_stage")
+        if self.zero_probe and self.zero_stage < 2:
+            raise ValueError(
+                "--zero_probe measures the stage-2/3 collectives; it "
+                "needs --zero_stage 2 or 3")
         if self.clip_grad_norm is not None:
             import math
             if (not math.isfinite(self.clip_grad_norm)
@@ -636,6 +671,12 @@ class Config:
                 "--eval_only evaluates a restored checkpoint; pass "
                 "--resume (and --model_dir) or there is nothing to "
                 "evaluate but random init")
+
+    @property
+    def zero_stage_effective(self) -> int:
+        """The ZeRO stage a run executes: --zero_stage when set,
+        else 1 under the --optimizer_sharding shorthand, else 0."""
+        return self.zero_stage or (1 if self.optimizer_sharding else 0)
 
     # -- dtype helpers -------------------------------------------------
     @property

@@ -64,6 +64,8 @@ KNOWN_EVENT_KINDS = (
     "canary_mirror", "canary_compare", "canary_drop", "prefix_rehome",
     # MFU/cost ledger (obs/ledger.py)
     "ledger_exec", "ledger_summary",
+    # ZeRO compute/comm overlap probe (train/loop.py --zero_probe)
+    "zero_overlap",
     # --profile_steps output-path marker (train/loop.py)
     "profiler_trace",
 )

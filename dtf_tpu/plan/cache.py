@@ -29,7 +29,8 @@ import os
 import tempfile
 from typing import List, Optional, Tuple
 
-from dtf_tpu.plan.cost_model import HBM_FRACTION, Plan, PlanCost
+from dtf_tpu.plan.cost_model import (DEFAULT_OVERLAP_FRAC, HBM_FRACTION,
+                                     Plan, PlanCost)
 from dtf_tpu.plan.mesh_spec import MeshSpec
 from dtf_tpu.plan.model_stats import ModelStats
 from dtf_tpu.plan.search import RankedPlan, search
@@ -37,12 +38,17 @@ from dtf_tpu.plan.search import RankedPlan, search
 log = logging.getLogger("dtf_tpu")
 
 # bump when the ranking function changes (cost model, lattice, sort
-# order) — stale entries must not resurrect an old ranking
-CACHE_VERSION = 1
+# order) — stale entries must not resurrect an old ranking.
+# v2: ZeRO stages 2/3 in the lattice + stage-aware wire-volume /
+#     peak-bytes terms + the exposed-comm overlap term (overlap_frac
+#     joins the key) — a v1 entry describes a DIFFERENT ranking
+#     function and must recompute, not serve
+CACHE_VERSION = 2
 
 
 def cache_key(stats: ModelStats, mesh: MeshSpec, global_batch: int,
-              optimizer: str, hbm_fraction: float = HBM_FRACTION
+              optimizer: str, hbm_fraction: float = HBM_FRACTION,
+              overlap_frac: float = DEFAULT_OVERLAP_FRAC
               ) -> Tuple[str, dict]:
     """(sha1 hex key, the human-readable payload stored beside it)."""
     payload = {
@@ -55,6 +61,7 @@ def cache_key(stats: ModelStats, mesh: MeshSpec, global_batch: int,
         "global_batch": int(global_batch),
         "optimizer": optimizer,
         "hbm_fraction": hbm_fraction,
+        "overlap_frac": overlap_frac,
     }
     blob = json.dumps(payload, sort_keys=True)
     return hashlib.sha1(blob.encode()).hexdigest(), payload
@@ -122,15 +129,18 @@ def store_ranking(path: str, key: str, payload: dict,
 
 
 def cached_search(path: str, stats: ModelStats, mesh: MeshSpec,
-                  global_batch: int, optimizer: str = "sgd"
+                  global_batch: int, optimizer: str = "sgd",
+                  overlap_frac: float = DEFAULT_OVERLAP_FRAC
                   ) -> Tuple[List[RankedPlan], bool]:
     """search() through the sidecar: (ranked, was_a_hit)."""
-    key, payload = cache_key(stats, mesh, global_batch, optimizer)
+    key, payload = cache_key(stats, mesh, global_batch, optimizer,
+                             overlap_frac=overlap_frac)
     cached = load_ranking(path, key)
     if cached is not None:
         log.info("plan cache hit (%s, %s, batch %d) — search skipped",
                  stats.model, mesh.name, global_batch)
         return cached, True
-    ranked = search(stats, mesh, global_batch, optimizer=optimizer)
+    ranked = search(stats, mesh, global_batch, optimizer=optimizer,
+                    overlap_frac=overlap_frac)
     store_ranking(path, key, payload, ranked)
     return ranked, False

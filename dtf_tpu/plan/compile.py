@@ -32,6 +32,7 @@ PLAN_OWNED_FLAGS = {
     "model_parallelism": 1,
     "seq_parallelism": 1,
     "optimizer_sharding": False,
+    "zero_stage": 0,
     "grad_accum_steps": 1,
     "num_microbatches": None,
     "remat": False,
@@ -81,7 +82,11 @@ def apply_plan(cfg, plan: Plan):
         num_devices=plan.num_devices,
         model_parallelism=plan.model_axis_size,
         seq_parallelism=plan.seq,
-        optimizer_sharding=bool(plan.zero),
+        # stage 1 keeps compiling into the historical shorthand flag;
+        # stages 2/3 into --zero_stage (the two are mutually exclusive
+        # by Config validation)
+        optimizer_sharding=plan.zero == 1,
+        zero_stage=plan.zero if plan.zero >= 2 else 0,
         remat=plan.remat,
     )
     if is_pipeline:
@@ -120,7 +125,7 @@ def plan_from_config(cfg, num_devices: int) -> Plan:
     return Plan(data=num_devices // (maxis * sp),
                 model=1 if is_pipeline else maxis,
                 pipeline=maxis if is_pipeline else 1,
-                seq=sp, zero=int(bool(cfg.optimizer_sharding)),
+                seq=sp, zero=cfg.zero_stage_effective,
                 microbatch=max(int(micro), 1),
                 remat=bool(cfg.remat or cfg.remat_policy))
 

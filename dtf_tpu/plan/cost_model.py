@@ -7,17 +7,34 @@ hardware) — the AMP/DistIR idea (arXiv:2210.07297, arXiv:2111.05426):
 rank the lattice analytically, touch the accelerators only to run the
 winner.
 
-Step-time model (no-overlap, i.e. conservative: real runs overlap ring
-hops with compute):
+Step-time model:
 
-    t_step = t_compute · bubble(pipeline) + Σ t_collective
+    t_step = t_compute · bubble(pipeline) + exposed(grad sync)
+             + t_tp_psum + t_seq_ring + t_pipe_xfer
 
     t_compute    = 3 · F_fwd (+ remat refwd) · B_global / D / flops_dev
                    (backward ≈ 2× forward MACs)
-    grad sync    = ring allreduce over 'data' (and 'seq'):
-                   2·(n-1)/n · grad_bytes_local / bw(axis); ZeRO-1's
-                   reduce-scatter + all-gather moves the SAME volume
-                   (its win is memory + update FLOPs, not wire bytes)
+    grad sync    = data-axis (and 'seq') weight collectives, staged:
+                     zero ∈ {0,1}: one ring allreduce — 2·(n-1)/n ·
+                       grad_bytes_local / bw; ZeRO-1's reduce-scatter
+                       + all-gather moves the SAME volume (its win is
+                       memory + update FLOPs, not wire bytes)
+                     zero ∈ {2,3}: (m + 1) half-collectives — one
+                       reduce-scatter per microbatch (grads shard as
+                       the backward produces them) + one param
+                       all-gather (stage 2: post-update; stage 3:
+                       pre-compute), each (n-1)/n · bytes / bw.  At
+                       m=1 the volume equals the allreduce.
+    overlap      = stages 2/3 schedule their collectives per leaf /
+                   per microbatch, so XLA hides part of them behind
+                   fwd/bwd compute: hidden = min(t_grad,
+                   overlap_frac · t_compute), exposed = t_grad −
+                   hidden.  ``overlap_frac`` defaults to
+                   DEFAULT_OVERLAP_FRAC and is calibrated against the
+                   measured --zero_probe gauges (plan_main
+                   --calibrate emits the run's implied fraction).
+                   Stages 0/1 do ONE monolithic end-of-step sync in
+                   this repo — no overlap credit.
     TP psums     = 4 per block (2 fwd + 2 bwd) of the [B_loc, S_loc, d]
                    residual stream over 'model'
     seq ring     = (sp-1) K/V neighbor hops per block (ring attention)
@@ -26,10 +43,16 @@ hops with compute):
 
 Memory model (per device, bytes):
 
-    params (f32) / TP·PP sharding
-  + gradients (f32, same sharding; ×2 under grad accumulation — the
-    scan carry holds the accumulator while a chunk's grads materialize)
-  + optimizer state (slots × params; ÷ data-parallel ways under ZeRO-1)
+    params (f32) / TP·PP sharding; ZeRO-3 holds the persistent copy
+    sliced (÷ dp) but still materializes the gathered working copy
+    during fwd/bwd — counted in full, honestly: the saving over the
+    replicated stages is the OTHER buffers
+  + gradients (f32, same sharding): zero < 2 pays the full buffer (×2
+    under grad accumulation — the scan carry holds the accumulator
+    while a chunk's grads materialize); zero ∈ {2,3} holds a 1/dp
+    sliced accumulator plus one layer's transient full grad (each
+    leaf's psum_scatter consumes it as the backward produces it)
+  + optimizer state (slots × params; ÷ dp under any ZeRO stage)
   + BN running stats
   + activations of ONE microbatch (÷ seq ways; the TP-shardable
     portion ÷ model ways; remat keeps only block inputs + one live
@@ -59,6 +82,12 @@ HBM_FRACTION = 0.9
 
 FIXED_OVERHEAD_BYTES = 64 * MiB
 
+# Fraction of compute the ZeRO-2/3 per-leaf collectives can hide
+# behind (XLA latency-hiding scheduler).  Deliberately conservative;
+# calibrate against the measured --zero_probe exposed-comm gauges and
+# override via predict(..., overlap_frac=) / plan_main --overlap_frac.
+DEFAULT_OVERLAP_FRAC = 0.5
+
 
 @dataclasses.dataclass(frozen=True)
 class Plan:
@@ -68,8 +97,9 @@ class Plan:
     both ride the runtime's 'model' mesh axis, so at most one of them
     may exceed 1; ``microbatch`` is sequential gradient-accumulation
     chunks for the dense families and the GPipe microbatch count for
-    the pipeline family; ``zero`` is the ZeRO stage (this repo
-    implements stage 1, --optimizer_sharding)."""
+    the pipeline family; ``zero`` is the ZeRO stage 0-3 (1 = sharded
+    optimizer state, 2 = + sharded gradients, 3 = + sharded params —
+    train/zero.py)."""
 
     data: int = 1
     model: int = 1
@@ -84,9 +114,9 @@ class Plan:
             if getattr(self, f) < 1:
                 raise ValueError(f"plan.{f} must be >= 1, got "
                                  f"{getattr(self, f)}")
-        if self.zero not in (0, 1):
-            raise ValueError(f"plan.zero must be 0 or 1 (this repo "
-                             f"implements ZeRO-1), got {self.zero}")
+        if self.zero not in (0, 1, 2, 3):
+            raise ValueError(f"plan.zero must be a ZeRO stage in "
+                             f"0..3, got {self.zero}")
         if self.model > 1 and self.pipeline > 1:
             raise ValueError(
                 "plan.model and plan.pipeline both ride the 'model' mesh "
@@ -226,13 +256,20 @@ def _ring_s(bytes_: float, ways: int, bw: float) -> float:
 def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
             global_batch: int, optimizer: str = "sgd",
             hbm_fraction: float = HBM_FRACTION,
-            device_flops: Optional[float] = None) -> PlanCost:
+            device_flops: Optional[float] = None,
+            overlap_frac: float = DEFAULT_OVERLAP_FRAC) -> PlanCost:
     """Predicted (step time, peak memory) for a valid plan.
 
     ``device_flops`` overrides the mesh's achievable-FLOP/s estimate —
-    the calibration loop passes the measured probe here.  Call
-    :func:`check_plan` first; predicting an invalid plan still returns
-    numbers, they just describe a run the framework would refuse."""
+    the calibration loop passes the measured probe here.
+    ``overlap_frac`` is the fraction of compute the ZeRO-2/3 per-leaf
+    collectives may hide behind (stages 0/1 sync monolithically and
+    get no credit).  Call :func:`check_plan` first; predicting an
+    invalid plan still returns numbers, they just describe a run the
+    framework would refuse."""
+    if not 0.0 <= overlap_frac <= 1.0:
+        raise ValueError(f"overlap_frac must be in [0, 1], got "
+                         f"{overlap_frac}")
     flops_dev = device_flops or mesh.device_flops
     slots = OPTIMIZER_SLOTS.get(optimizer)
     if slots is None:
@@ -243,6 +280,7 @@ def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
 
     # ---- parameters / gradients / optimizer state (f32) --------------
     param_local = 0.0
+    max_layer_param = 0.0  # largest single layer's local params
     fwd_flops = 0.0       # per example, whole model
     remat_refwd = 0.0     # extra forward FLOPs per example under remat
     act_local = 0.0       # per-device activation bytes, one microbatch
@@ -256,6 +294,7 @@ def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
         if layer.stage and pp > 1:
             p /= pp
         param_local += p
+        max_layer_param = max(max_layer_param, p)
         fwd_flops += layer.flops
         la = float(layer.act_bytes)
         if mp > 1 and layer.act_tp_bytes:
@@ -272,8 +311,29 @@ def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
             max_block_act = max(max_block_act,
                                 float(layer.act_bytes) / max(sp, 1))
     param_bytes = param_local * 4
-    grad_bytes = param_bytes * (2 if plan.microbatch > 1 else 1)
+    # stage >= 2's sharded gradient ACCUMULATOR only exists when the
+    # outer grad-accumulation scan runs (microbatch > 1, dense
+    # families — the pipeline family's GPipe scan accumulates a full
+    # grad tree internally): sliced 1/dp carry + one layer's transient
+    # full grad (each leaf's reduce-scatter consumes it as the
+    # backward emits it).  At microbatch=1 the emitted program
+    # materializes the full grad tree exactly like stages 0/1 do, so
+    # it is priced identically — two identical programs must not get
+    # different predictions.
+    if plan.zero >= 2 and plan.microbatch > 1 and pp == 1:
+        grad_bytes = param_bytes / dp + max_layer_param * 4
+    else:
+        grad_bytes = param_bytes * (2 if plan.microbatch > 1 else 1)
     opt_bytes = slots * param_bytes / (dp if plan.zero else 1)
+    if plan.zero == 3:
+        # persistent copy sliced; the gathered working copy still
+        # counted IN FULL — the per-leaf gathers live through the
+        # backward in the emitted program (honest accounting: ZeRO-3's
+        # win here is the grads + optimizer terms, and the sliced
+        # persistent set between steps)
+        param_term = param_bytes / dp + param_bytes
+    else:
+        param_term = param_bytes
     state_bytes = stats.state * 4
 
     act_bytes = act_local * micro_examples
@@ -286,7 +346,7 @@ def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
         act_bytes += (plan.microbatch * micro_examples
                       * boundary_bytes / max(sp, 1))
 
-    peak = int(param_bytes + grad_bytes + opt_bytes + state_bytes
+    peak = int(param_term + grad_bytes + opt_bytes + state_bytes
                + act_bytes + FIXED_OVERHEAD_BYTES)
     budget = int(mesh.hbm_bytes * hbm_fraction)
 
@@ -302,9 +362,40 @@ def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
 
     # ---- collectives --------------------------------------------------
     breakdown: Dict[str, float] = {}
-    t_grad = _ring_s(param_local * 4, dp, _axis_bw(mesh, plan, "data"))
-    t_grad += _ring_s(param_local * 4, sp, _axis_bw(mesh, plan, "seq"))
+    if plan.zero >= 2:
+        # one reduce-scatter per microbatch (the pipeline family's
+        # grads arrive once from the GPipe scan, so it scatters once)
+        # + one param all-gather, each a HALF allreduce; at m=1 the
+        # volume equals the stage-0/1 ring allreduce
+        scatters = plan.microbatch if pp == 1 else 1
+        halves = (scatters + 1) / 2.0
+        t_grad = halves * _ring_s(param_local * 4, dp,
+                                  _axis_bw(mesh, plan, "data"))
+        t_grad += halves * _ring_s(param_local * 4, sp,
+                                   _axis_bw(mesh, plan, "seq"))
+    else:
+        t_grad = _ring_s(param_local * 4, dp, _axis_bw(mesh, plan, "data"))
+        t_grad += _ring_s(param_local * 4, sp, _axis_bw(mesh, plan, "seq"))
     breakdown["grad_sync_s"] = t_grad
+    # compute/comm overlap: credit only the collectives whose SCHEDULE
+    # differs from the monolithic end-of-step sync.  Of stage >= 2's
+    # (scatters + 1) half-collectives, the per-microbatch scatters
+    # EXCEPT THE LAST interleave with the following chunk's compute,
+    # and stage 3's pre-compute param gather interleaves with the
+    # forward; the final scatter (and stage 2's post-update gather)
+    # stay exposed.  At m=1 stage 2 therefore earns NO credit — it
+    # emits the same program as stage 1 and must be priced like it.
+    hidden = 0.0
+    ov_share = 0.0
+    if plan.zero >= 2:
+        scatters = plan.microbatch if pp == 1 else 1
+        ov_halves = (scatters - 1) + (1 if plan.zero == 3 else 0)
+        ov_share = ov_halves / (scatters + 1)
+    if ov_share > 0 and overlap_frac > 0:
+        hidden = min(ov_share * t_grad, overlap_frac * compute_s)
+    t_grad_exposed = t_grad - hidden
+    breakdown["hidden_comm_s"] = hidden
+    breakdown["overlap_frac"] = overlap_frac if ov_share > 0 else 0.0
 
     t_tp = 0.0
     if mp > 1:
@@ -332,10 +423,12 @@ def predict(plan: Plan, stats: ModelStats, mesh: MeshSpec,
                   / _axis_bw(mesh, plan, "model"))
     breakdown["pipeline_xfer_s"] = t_pipe
 
-    comm_s = t_grad + t_tp + t_ring + t_pipe
+    comm_s = t_grad_exposed + t_tp + t_ring + t_pipe
     breakdown.update(
         compute_s=compute_s, bubble_factor=bubble,
-        param_bytes=param_bytes, grad_bytes=grad_bytes,
+        exposed_comm_s=comm_s,
+        param_bytes=param_bytes, param_term_bytes=param_term,
+        grad_bytes=grad_bytes,
         opt_bytes=opt_bytes, act_bytes=act_bytes)
     return PlanCost(step_time_s=compute_s + comm_s, peak_bytes=peak,
                     hbm_budget_bytes=budget, compute_s=compute_s,

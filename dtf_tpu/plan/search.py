@@ -15,8 +15,8 @@ import dataclasses
 import itertools
 from typing import Iterator, List, Optional, Tuple
 
-from dtf_tpu.plan.cost_model import (HBM_FRACTION, Plan, PlanCost,
-                                     check_plan, predict)
+from dtf_tpu.plan.cost_model import (DEFAULT_OVERLAP_FRAC, HBM_FRACTION,
+                                     Plan, PlanCost, check_plan, predict)
 from dtf_tpu.plan.mesh_spec import MeshSpec
 from dtf_tpu.plan.model_stats import ModelStats
 
@@ -70,7 +70,7 @@ def enumerate_plans(stats: ModelStats, mesh: MeshSpec, global_batch: int
             axis_roles = [(1, maxis)]
         for model, pipeline in axis_roles:
             for zero, micro, remat in itertools.product(
-                    (0, 1), micro_opts,
+                    (0, 1, 2, 3), micro_opts,
                     (False, True) if stats.supports_remat else (False,)):
                 try:
                     plan = Plan(data=data, model=model, seq=seq,
@@ -87,7 +87,8 @@ def enumerate_plans(stats: ModelStats, mesh: MeshSpec, global_batch: int
 
 def search(stats: ModelStats, mesh: MeshSpec, global_batch: int,
            optimizer: str = "sgd", hbm_fraction: float = HBM_FRACTION,
-           device_flops: Optional[float] = None) -> List[RankedPlan]:
+           device_flops: Optional[float] = None,
+           overlap_frac: float = DEFAULT_OVERLAP_FRAC) -> List[RankedPlan]:
     """Rank the whole valid lattice: feasible plans first by predicted
     step time, then infeasible ones by how far over budget they are
     (the artifact keeps them so an operator can see WHY a tempting
@@ -95,7 +96,8 @@ def search(stats: ModelStats, mesh: MeshSpec, global_batch: int,
     ranked = [RankedPlan(plan, predict(plan, stats, mesh, global_batch,
                                        optimizer=optimizer,
                                        hbm_fraction=hbm_fraction,
-                                       device_flops=device_flops))
+                                       device_flops=device_flops,
+                                       overlap_frac=overlap_frac))
               for plan in enumerate_plans(stats, mesh, global_batch)]
     # feasible first by predicted step time; the analytic times
     # quantize so ties are common — break them toward the FEWEST

@@ -610,6 +610,77 @@ def rank_tp_vs_replicas(workload: Workload, profile: ServeProfile,
     return out
 
 
+@dataclasses.dataclass(frozen=True)
+class CostRankedConfig:
+    """One row of :func:`rank_cost_per_token`."""
+
+    config: FleetConfig
+    prediction: FleetPrediction
+    meets_slo: bool
+    usd_per_mtoken: float
+    usd_per_hour: float
+
+    def to_dict(self) -> dict:
+        finite = self.usd_per_mtoken != float("inf")
+        return {"config": self.config.to_dict(),
+                "prediction": self.prediction.to_dict(),
+                "meets_slo": self.meets_slo,
+                # None, not Infinity: the artifact stays strict JSON
+                "usd_per_mtoken": (self.usd_per_mtoken if finite
+                                   else None),
+                "usd_per_hour": self.usd_per_hour}
+
+
+def rank_cost_per_token(workload: Workload, profile: ServeProfile,
+                        config: FleetConfig, chips: int,
+                        chip_cost_per_hour: float, slo_p99_s: float, *,
+                        loss_bar: float = DEFAULT_LOSS_BAR,
+                        evaluated: Optional[
+                            List[Tuple[FleetConfig,
+                                       FleetPrediction]]] = None
+                        ) -> List[CostRankedConfig]:
+    """Rank every tp × replicas split of a chip budget by **$/token at
+    the SLO** — the capacity-sim follow-on the MFU ledger enables: the
+    ledger knows chips and achieved throughput, so feasibility alone
+    is no longer the interesting verdict; the cheapest config that
+    still meets the p99 SLO and the loss bar is.
+
+    A fleet's dollar rate is ``chips × chip_cost_per_hour`` (every
+    ranked split uses the full budget, but the rate is computed per
+    config so partial splits of non-power-of-two budgets price
+    honestly); delivered tokens/s comes from the simulator, so
+    $/Mtoken = rate / (3600 · tokens_per_s) · 1e6.  Configs that MISS
+    the SLO or the loss bar rank strictly below every config that
+    meets them — a cheap config that sheds is not a bargain — ordered
+    among themselves by $/Mtoken for the "what would it take" view.
+
+    ``evaluated`` reuses :func:`rank_tp_vs_replicas`' (config,
+    prediction) pairs when the caller already simulated the splits
+    (the CLI runs both what-ifs on one pass); None simulates here."""
+    if chips < 1:
+        raise ValueError(f"chips must be >= 1, got {chips}")
+    if chip_cost_per_hour <= 0:
+        raise ValueError(f"chip_cost_per_hour must be positive, got "
+                         f"{chip_cost_per_hour}")
+    if slo_p99_s <= 0:
+        raise ValueError(f"slo_p99_s must be positive, got {slo_p99_s}")
+    if evaluated is None:
+        evaluated = rank_tp_vs_replicas(workload, profile, config,
+                                        chips, loss_bar=loss_bar)
+    rows: List[CostRankedConfig] = []
+    for cfg, pred in evaluated:
+        meets = bool(pred.completed
+                     and pred.latency_p99_s <= slo_p99_s
+                     and pred.loss_rate <= loss_bar)
+        rate = cfg.chips * chip_cost_per_hour
+        usd_mtok = (rate / 3600.0 / pred.tokens_per_s * 1e6
+                    if pred.tokens_per_s > 0 else float("inf"))
+        rows.append(CostRankedConfig(cfg, pred, meets, usd_mtok, rate))
+    rows.sort(key=lambda r: (not r.meets_slo, r.usd_per_mtoken,
+                             r.prediction.latency_p99_s))
+    return rows
+
+
 def pool_vs_shed(workload: Workload, profile: ServeProfile,
                  config: FleetConfig, pool_sizes: Sequence[int], *,
                  loss_bar: float = DEFAULT_LOSS_BAR

@@ -416,8 +416,11 @@ def load_train_checkpoint(model_dir: str, step: Optional[int] = None):
     The restore is structure-free (orbax rebuilds the pytree from the
     checkpoint's own metadata), so a serving process does not need the
     training run's optimizer/loss-scale configuration — including
-    ZeRO-sharded runs, whose sliced optimizer state is simply dropped.
-    Returns None when ``model_dir`` has no checkpoint.
+    ZeRO runs at ANY stage: their checkpoints are written in the
+    canonical stage-0 layout (CheckpointCallback's ``state_transform``
+    = Trainer.canonical_state), so params arrive full-shaped and the
+    optimizer state is simply dropped.  Returns None when
+    ``model_dir`` has no checkpoint.
 
     Same integrity fallback as the trainer's restore: a corrupt or
     mid-write newest step (the training run may still be saving, or
@@ -508,14 +511,26 @@ class CheckpointCallback:
       keep         — cross-run GC budget (--checkpoint_keep): after the
           final wait() seals everything, delete all but the newest
           `keep` verified steps (Checkpointer.gc safety rules apply)
+      state_transform(state) — applied to the live state before EVERY
+          save.  The ZeRO path passes Trainer.canonical_state here so
+          checkpoints are always written in the stage-0 layout
+          (full-shaped params + optimizer state): any stage restores
+          into any other stage and into serving via the bridge, at the
+          cost of one param-sized gather per save
     """
 
     def __init__(self, model_dir: str, max_to_keep: int = 3,
-                 every_steps: int = 0, host_state_fn=None, keep: int = 0):
+                 every_steps: int = 0, host_state_fn=None, keep: int = 0,
+                 state_transform=None):
         self.ckpt = Checkpointer(model_dir, max_to_keep=max_to_keep)
         self.every_steps = int(every_steps or 0)
         self.host_state_fn = host_state_fn
         self.keep = int(keep or 0)
+        self.state_transform = state_transform
+
+    def _saveable(self, state):
+        return (state if self.state_transform is None
+                else self.state_transform(state))
 
     def _host(self, step: int) -> Optional[dict]:
         if self.host_state_fn is None:
@@ -529,7 +544,7 @@ class CheckpointCallback:
             return
         step = int(logs["step"])
         if step and step % self.every_steps == 0:
-            self.ckpt.save(logs["state"], step=step,
+            self.ckpt.save(self._saveable(logs["state"]), step=step,
                            host_state=self._host(step), sync=True)
 
     def on_epoch_end(self, epoch: int, logs=None):
@@ -543,7 +558,8 @@ class CheckpointCallback:
             # crash in that window leaves the step "unverified" — still
             # restorable, just not digest-guaranteed.  Only interval
             # and preemption saves pay for synchronous durability.
-            self.ckpt.save(logs["state"], host_state=self._host(step))
+            self.ckpt.save(self._saveable(logs["state"]),
+                           host_state=self._host(step))
 
     def on_preempt(self, logs=None):
         if not logs or "state" not in logs:
@@ -552,7 +568,7 @@ class CheckpointCallback:
         if self.ckpt.latest_step() == step:
             self.ckpt.wait()  # already saved this boundary — just seal
             return
-        self.ckpt.save(logs["state"], step=step,
+        self.ckpt.save(self._saveable(logs["state"]), step=step,
                        host_state=self._host(step), sync=True)
 
     def on_train_end(self, logs=None):

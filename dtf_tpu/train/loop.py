@@ -49,6 +49,7 @@ from dtf_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, SEQ_AXIS,
                                   MeshRuntime)
 from dtf_tpu.train import preemption
 from dtf_tpu.train import schedules as sched_lib
+from dtf_tpu.train import zero as zero_lib
 from dtf_tpu.train.optimizer import build_optimizer
 from dtf_tpu.utils.logs import TimeHistory, build_stats
 
@@ -74,32 +75,9 @@ DYNAMIC_SCALE_INIT = 2.0 ** 15
 DYNAMIC_GROWTH_INTERVAL = 2000
 
 
-def _pad_flat(p, nd: int):
-    """Flatten and zero-pad to a multiple of `nd` (the ZeRO slice
-    grid); padding lives at the tail and is sliced off after gather."""
-    flat = p.reshape(-1)
-    k = -(-flat.size // nd)
-    pad = nd * k - flat.size
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    return flat
-
-
-def _zero_opt_leaf_spec(spec):
-    """Optimizer-state PartitionSpec for one param leaf under ZeRO-1.
-
-    Leaves already sharded over 'data' (MoE experts riding the batch
-    axis) keep locally-shaped state — each data shard already holds
-    distinct experts, so there is nothing left to slice.  Every other
-    leaf's state is a padded flat buffer sliced over 'data' — composed
-    with 'model' when the param itself is TP/PP-sharded there (each
-    (data, model) coordinate owns one slice of the local shard)."""
-    axes = _spec_axes(spec)
-    if DATA_AXIS in axes:
-        return spec
-    if MODEL_AXIS in axes:
-        return P((DATA_AXIS, MODEL_AXIS))
-    return P(DATA_AXIS)
+# ZeRO slice layout + per-leaf collective helpers live in
+# dtf_tpu/train/zero.py (shared with the canonical-checkpoint
+# conversions); loop.py only orchestrates them per stage.
 
 
 def per_example_cross_entropy(logits, labels):
@@ -252,14 +230,19 @@ class Trainer:
         self.loss_scale = (1.0 if self.dynamic_scale
                            else float(cfg.loss_scale_value))
 
-        # ZeRO-1 weight-update sharding (PAPERS.md: Xu et al. 2020):
-        # optimizer state lives sliced over the data axis, gradients
-        # reduce-scatter instead of all-reduce, updated slices
-        # all-gather back.  Composes with TP/EP/PP param sharding:
+        # ZeRO weight-update sharding (PAPERS.md: Xu et al. 2020),
+        # stages 1-3 on the data axis (train/zero.py has the layout
+        # contract).  Stage 1: optimizer state sliced, grads
+        # reduce-scatter, updated slices all-gather back.  Stage 2: the
+        # grad-accumulation carry holds 1/nd slices — each microbatch's
+        # grads scatter as the backward produces them.  Stage 3: params
+        # themselves live sliced and all-gather per leaf at the top of
+        # the step.  Composes with TP/EP/PP param sharding:
         # model-sharded leaves slice their *local* shard over 'data'
-        # (state spec ('data','model')); expert leaves riding 'data'
-        # keep locally-shaped state (_zero_opt_leaf_spec).
-        self.zero = bool(cfg.optimizer_sharding)
+        # (spec ('data','model')); expert leaves riding 'data' keep
+        # locally-shaped state (zero_lib.zero_leaf_spec).
+        self.zero_stage = cfg.zero_stage_effective
+        self.zero = self.zero_stage >= 1
 
         if self.param_spec_fn is None and not self.zero:
             self._build_steps()
@@ -298,7 +281,7 @@ class Trainer:
         if self.zero:
             # optimizer state over PADDED FLAT leaves [nd·k] (per
             # (data, model) coordinate when the param is model-sharded;
-            # locally-shaped for expert leaves — _zero_opt_leaf_spec).
+            # locally-shaped for expert leaves — zero_lib.zero_leaf_spec).
             # Init under jit with sharded out_shardings so the full
             # state never materializes on one device (the transient
             # spike would OOM exactly the model sizes this targets)
@@ -317,7 +300,7 @@ class Trainer:
             pspecs = (self.param_spec_fn(params)
                       if self.param_spec_fn is not None
                       else jax.tree_util.tree_map(lambda _: P(), params))
-            opt_pspecs = jax.tree_util.tree_map(_zero_opt_leaf_spec,
+            opt_pspecs = jax.tree_util.tree_map(zero_lib.zero_leaf_spec,
                                                 pspecs, is_leaf=is_p)
 
             def proto_leaf(spec, p):
@@ -350,8 +333,29 @@ class Trainer:
             good_steps=(jnp.zeros((), jnp.int32)
                         if self.dynamic_scale else None))
         if self.zero:
-            state_specs = self._make_zero_state_specs(state, pspecs,
-                                                      opt_pspecs)
+            # static trees the stage-3 gather and the canonical-
+            # checkpoint conversions close over: the model partition
+            # specs, and each leaf's shard_map-LOCAL full shape
+            self._zero_pspecs = pspecs
+            self._zero_local_sds = jax.tree_util.tree_map(
+                lambda spec, p: jax.ShapeDtypeStruct(
+                    zero_lib.local_shape(spec, p.shape, mesh_shape),
+                    p.dtype),
+                pspecs, params, is_leaf=is_p)
+            param_state_specs = pspecs
+            if self.zero_stage == 3:
+                # params themselves live as ZeRO slices
+                param_state_specs = jax.tree_util.tree_map(
+                    zero_lib.zero_leaf_spec, pspecs, is_leaf=is_p)
+            state_specs = self._make_zero_state_specs(
+                state, param_state_specs, opt_pspecs)
+            self._state_specs = state_specs
+            self._build_canonical(state, pspecs, opt_pspecs, state_specs)
+            if self.zero_stage == 3:
+                # move the seed-synced replicated init into the sliced
+                # layout (the replicated copy is a transient of init;
+                # restores go through staged_state and never rebuild it)
+                state = state.replace(params=self._slice_params(params))
             self._build_steps(state_specs)
             shardings = jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.rt.mesh, s), state_specs,
@@ -369,13 +373,13 @@ class Trainer:
             is_leaf=lambda x: isinstance(x, P))
         return jax.device_put(state, shardings)
 
-    def _make_zero_state_specs(self, state: TrainState, pspecs,
+    def _make_zero_state_specs(self, state: TrainState, param_specs,
                                opt_pspecs):
         from dtf_tpu.train.optimizer import opt_state_specs
         rep = P()
         return TrainState(
             step=rep,
-            params=pspecs,
+            params=param_specs,
             batch_stats=jax.tree_util.tree_map(lambda _: rep,
                                                state.batch_stats),
             opt_state=opt_state_specs(self.cfg.optimizer, opt_pspecs, rep),
@@ -394,6 +398,140 @@ class Trainer:
             opt_state=opt_state_specs(self.cfg.optimizer, pspecs, rep),
             loss_scale=rep if self.dynamic_scale else None,
             good_steps=rep if self.dynamic_scale else None)
+
+    # ------------------------------------------------------------------
+    # Canonical checkpoint form (ZeRO stages).  Checkpoints are always
+    # WRITTEN in the stage-0 layout — full-shaped params and optimizer
+    # state — so a checkpoint saved at any ZeRO stage restores into any
+    # other stage and into serving via the bridge's structure-free
+    # loader.  The conversions are pure per-leaf reshapes/collectives
+    # (train/zero.py): gather-trim-reshape out, pad-flatten-slice back
+    # in.  Padding rows are zeros in every supported optimizer's state
+    # (optimizer.ZEROS_INIT_OPTIMIZERS), so dropping them on save and
+    # re-creating them on restore is exact — the round trip is
+    # bit-identical, which is what keeps killed-at-K resume trajectory-
+    # exact under ZeRO-3 (tests/test_zero_stages.py).
+    # ------------------------------------------------------------------
+    def _build_canonical(self, state: TrainState, pspecs, opt_pspecs,
+                         state_specs):
+        from dtf_tpu.train.optimizer import opt_state_specs
+        mesh = self.rt.mesh
+        nd = mesh.shape[DATA_AXIS]
+        is_p = zero_lib.is_spec
+        stage3 = self.zero_stage == 3
+        local_sds = self._zero_local_sds
+        # canonical spec/shape trees: params carry the model partition
+        # specs; optimizer leaves mirror their params, with genuinely
+        # replicated leaves (the adam step count) marked REP so the
+        # converters know there is nothing to slice
+        opt_canon_specs = opt_state_specs(self.cfg.optimizer, pspecs,
+                                          zero_lib.REP)
+        opt_local_sds = opt_state_specs(
+            self.cfg.optimizer, local_sds,
+            jax.ShapeDtypeStruct((), jnp.int32))
+        canon_specs = TrainState(
+            step=P(), params=zero_lib.concrete_specs(pspecs),
+            batch_stats=jax.tree_util.tree_map(lambda _: P(),
+                                               state.batch_stats),
+            opt_state=zero_lib.concrete_specs(opt_canon_specs),
+            loss_scale=P() if self.dynamic_scale else None,
+            good_steps=P() if self.dynamic_scale else None)
+        self._canon_specs = canon_specs
+
+        def gather_opt_leaf(spec, sds, leaf):
+            return zero_lib.gather_leaf(spec, leaf, sds.shape, sds.dtype,
+                                        nd)
+
+        def to_canonical_local(st: TrainState) -> TrainState:
+            p = st.params
+            if stage3:
+                p = zero_lib.tree_map_specs(gather_opt_leaf, pspecs,
+                                            local_sds, p)
+            opt = zero_lib.tree_map_specs(gather_opt_leaf,
+                                          opt_canon_specs, opt_local_sds,
+                                          st.opt_state)
+            return st.replace(params=p, opt_state=opt)
+
+        def to_staged_local(st: TrainState) -> TrainState:
+            idx = lax.axis_index(DATA_AXIS)
+            p = st.params
+            if stage3:
+                p = zero_lib.tree_map_specs(
+                    lambda spec, leaf: zero_lib.slice_leaf(spec, leaf, nd,
+                                                           idx),
+                    pspecs, p)
+            opt = zero_lib.tree_map_specs(
+                lambda spec, leaf: zero_lib.slice_leaf(spec, leaf, nd,
+                                                       idx),
+                opt_canon_specs, st.opt_state)
+            return st.replace(params=p, opt_state=opt)
+
+        self._to_canonical = jax.jit(jax.shard_map(
+            to_canonical_local, mesh=mesh, in_specs=(state_specs,),
+            out_specs=canon_specs, check_vma=False))
+        self._to_staged = jax.jit(jax.shard_map(
+            to_staged_local, mesh=mesh, in_specs=(canon_specs,),
+            out_specs=state_specs, check_vma=False))
+
+        def slice_params_local(p):
+            idx = lax.axis_index(DATA_AXIS)
+            return zero_lib.tree_map_specs(
+                lambda spec, leaf: zero_lib.slice_leaf(spec, leaf, nd,
+                                                       idx),
+                pspecs, p)
+
+        self._slice_params = jax.jit(jax.shard_map(
+            slice_params_local, mesh=mesh,
+            in_specs=(zero_lib.concrete_specs(pspecs),),
+            out_specs=state_specs.params, check_vma=False))
+
+        template = TrainState(
+            step=jax.ShapeDtypeStruct((), jnp.int32),
+            params=jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
+                state.params),
+            batch_stats=jax.tree_util.tree_map(
+                lambda b: jax.ShapeDtypeStruct(b.shape, b.dtype),
+                state.batch_stats),
+            opt_state=jax.eval_shape(self.tx.init, state.params),
+            loss_scale=(jax.ShapeDtypeStruct((), jnp.float32)
+                        if self.dynamic_scale else None),
+            good_steps=(jax.ShapeDtypeStruct((), jnp.int32)
+                        if self.dynamic_scale else None))
+        # restore places directly into the canonical shardings (a TP
+        # leaf never materializes replicated on one device)
+        canon_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), canon_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self._canonical_template = jax.tree_util.tree_map(
+            lambda sds, sh: jax.ShapeDtypeStruct(sds.shape, sds.dtype,
+                                                 sharding=sh),
+            template, canon_shardings)
+
+    def canonical_state(self, state: TrainState) -> TrainState:
+        """The stage-0 (checkpoint wire) form of a live TrainState —
+        identity for non-ZeRO runs."""
+        if not self.zero:
+            return state
+        return self._to_canonical(state)
+
+    def staged_state(self, canonical: TrainState) -> TrainState:
+        """A restored canonical TrainState placed into THIS run's stage
+        layout (sliced params/optimizer state, proper shardings)."""
+        if not self.zero:
+            return canonical
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.rt.mesh, s), self._canon_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        return self._to_staged(jax.device_put(canonical, shardings))
+
+    def canonical_template(self):
+        """ShapeDtypeStruct tree of the canonical checkpoint form (the
+        restore template — stage-independent).  Only meaningful after
+        init_state on a ZeRO run; non-ZeRO runs restore against the
+        live state directly."""
+        assert self.zero, "canonical_template is the ZeRO restore path"
+        return self._canonical_template
 
     # ------------------------------------------------------------------
     def _apply(self, params, batch_stats, images, train):
@@ -415,7 +553,14 @@ class Trainer:
             return out, new_stats, aux
         return self.model.apply(variables, images, train=False), batch_stats
 
-    def _build_steps(self, state_specs=None):
+    def _build_steps(self, state_specs=None, comm_off=False):
+        """Builds the jitted SPMD train/eval steps.  ``comm_off=True``
+        builds and RETURNS the ``--zero_probe`` timing twin instead of
+        installing it: the same step with every data-axis ZeRO
+        collective replaced by a shape-right local stub (train/zero.py)
+        — its wall time is the step minus those collectives, which is
+        what turns exposed-comm into a measured number.  Twin results
+        are garbage by construction and must never become state."""
         mesh = self.rt.mesh
         # token data shards [B, S] over (data, seq); vision shards dim 0
         if self.spec.is_sequence:
@@ -438,7 +583,15 @@ class Trainer:
         # so they are divided by the axis size to match the global-mean
         # loss convention instead.
         param_specs = None if state_specs is None else state_specs.params
+        if self.zero_stage == 3 and state_specs is not None:
+            # state_specs.params is the SLICED layout; the step's grad
+            # reduction / clipping / L2 reason about the gathered full
+            # params, whose layout is the model partition specs
+            param_specs = self._zero_pspecs
+        local_sds = getattr(self, "_zero_local_sds", None)
         mesh_shape = dict(mesh.shape)
+        nd = mesh_shape[DATA_AXIS]
+        zero_stage = self.zero_stage
 
         def reduce_grads(grads):
             if param_specs is None:
@@ -534,6 +687,24 @@ class Trainer:
                 images = normalize(images)
             scale = state.loss_scale if dynamic else loss_scale
 
+            is_p = zero_lib.is_spec
+            zspecs = param_specs
+            if zero:
+                idx = lax.axis_index(DATA_AXIS)
+
+            # ZeRO-3: the params the model computes with are gathered
+            # PER LEAF from their 1/nd slices at the top of the step —
+            # each leaf's all_gather is an independent op feeding that
+            # leaf's first use, so XLA's latency-hiding scheduler can
+            # overlap later layers' gathers with earlier layers' compute
+            if zero_stage == 3:
+                model_params = jax.tree_util.tree_map(
+                    lambda spec, sds, s: zero_lib.gather_leaf(
+                        spec, s, sds.shape, sds.dtype, nd, comm_off),
+                    zspecs, local_sds, state.params, is_leaf=is_p)
+            else:
+                model_params = state.params
+
             def grad_of_chunk(params, batch_stats, imgs, lbls):
                 def loss_fn(p):
                     logits, new_stats, aux = self._apply(
@@ -544,9 +715,48 @@ class Trainer:
                                           new_stats)
                 return jax.grad(loss_fn, has_aux=True)(params)
 
+            def scatter_tree(grads):
+                return jax.tree_util.tree_map(
+                    lambda spec, g: zero_lib.scatter_leaf(
+                        spec, g, nd, reduce_axes, mesh_shape, comm_off,
+                        idx),
+                    zspecs, grads, is_leaf=is_p)
+
+            g_slices_acc = None
             if accum == 1:
                 grads, (loss, acc, new_stats) = grad_of_chunk(
-                    state.params, state.batch_stats, images, labels)
+                    model_params, state.batch_stats, images, labels)
+            elif zero_stage >= 2:
+                # ZeRO-2/3 sharded gradient accumulation: each chunk's
+                # grads reduce-scatter into f32 slices AS THE BACKWARD
+                # PRODUCES THEM (per-leaf psum_scatter adjacent to its
+                # producing op — XLA can overlap the wire with compute
+                # and free each full grad immediately), so the scan
+                # carry holds 1/nd-sized slices instead of a second
+                # full gradient buffer
+                chunks = jax.tree_util.tree_map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum)
+                                        + x.shape[1:]), (images, labels))
+
+                def body(carry, chunk):
+                    gacc, stats, lacc, aacc = carry
+                    g, (l, a, stats) = grad_of_chunk(
+                        model_params, stats, *chunk)
+                    gacc = jax.tree_util.tree_map(jnp.add, gacc,
+                                                  scatter_tree(g))
+                    return (gacc, stats, lacc + l, aacc + a), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda spec, p: zero_lib.slice_zeros(spec, p, nd),
+                    zspecs, model_params, is_leaf=is_p)
+                (gsum, new_stats, lsum, asum), _ = lax.scan(
+                    body, (zeros, state.batch_stats,
+                           jnp.zeros((), jnp.float32),
+                           jnp.zeros((), jnp.float32)), chunks)
+                g_slices_acc = jax.tree_util.tree_map(
+                    lambda s: s / accum, gsum)
+                grads = None
+                loss, acc = lsum / accum, asum / accum
             else:
                 # sequential microbatches: grads accumulate in the scan
                 # carry (one buffer, not A stacked copies); BN stats
@@ -558,63 +768,47 @@ class Trainer:
                 def body(carry, chunk):
                     gacc, stats, lacc, aacc = carry
                     g, (l, a, stats) = grad_of_chunk(
-                        state.params, stats, *chunk)
+                        model_params, stats, *chunk)
                     gacc = jax.tree_util.tree_map(jnp.add, gacc, g)
                     return (gacc, stats, lacc + l, aacc + a), None
 
                 zeros = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.promote_types(
-                        p.dtype, jnp.float32)), state.params)
+                        p.dtype, jnp.float32)), model_params)
                 (gsum, new_stats, lsum, asum), _ = lax.scan(
                     body, (zeros, state.batch_stats,
                            jnp.zeros((), jnp.float32),
                            jnp.zeros((), jnp.float32)), chunks)
                 grads = jax.tree_util.tree_map(
                     lambda g, p: (g / accum).astype(p.dtype),
-                    gsum, state.params)
+                    gsum, model_params)
                 loss, acc = lsum / accum, asum / accum
             if dynamic or loss_scale != 1.0:
-                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+                # linear, so unscaling slices ≡ unscaling full grads
+                if g_slices_acc is not None:
+                    g_slices_acc = jax.tree_util.tree_map(
+                        lambda g: g / scale, g_slices_acc)
+                else:
+                    grads = jax.tree_util.tree_map(lambda g: g / scale,
+                                                   grads)
             # per-replica BN stats averaged on update — MirroredStrategy's
             # variable aggregation semantics
             new_stats = jax.lax.pmean(new_stats, reduce_axes)
 
             if zero:
-                # ZeRO-1 weight-update sharding: the gradient all-reduce
+                # ZeRO weight-update sharding: the gradient all-reduce
                 # becomes a reduce-scatter (same ICI volume), each data
                 # shard updates its 1/nd slice with its 1/nd optimizer
-                # state, and the updated slices all-gather back.
-                # Composed with model sharding: a TP/PP leaf slices its
-                # LOCAL shard (scatter/gather stay pure-'data'
-                # collectives); an expert leaf riding 'data' updates in
-                # place (its grads were already summed by the
-                # all_to_all transpose — divide to the global-mean
-                # convention like reduce_grads does).
-                nd = mesh_shape[DATA_AXIS]
-                idx = lax.axis_index(DATA_AXIS)
-                is_p = lambda x: isinstance(x, P)
-                zspecs = param_specs
-
-                def scatter(spec, g):
-                    sharded = _spec_axes(spec)
-                    if DATA_AXIS in sharded:
-                        axes = tuple(a for a in reduce_axes
-                                     if a not in sharded)
-                        if axes:
-                            g = jax.lax.pmean(g, axes)
-                        denom = 1
-                        for a in reduce_axes:
-                            if a in sharded:
-                                denom *= mesh_shape[a]
-                        return (g / denom).astype(jnp.float32)
-                    flat = _pad_flat(g.astype(jnp.float32), nd)
-                    s = lax.psum_scatter(flat, DATA_AXIS,
-                                         scatter_dimension=0,
-                                         tiled=True) / nd
-                    return lax.pmean(s, SEQ_AXIS)
-
-                g_slices = jax.tree_util.tree_map(scatter, zspecs, grads,
-                                                  is_leaf=is_p)
+                # state, and (stages 1-2) the updated slices all-gather
+                # back — stage 3 keeps them sliced for the next step's
+                # per-leaf gather.  Composed with model sharding: a
+                # TP/PP leaf slices its LOCAL shard (scatter/gather
+                # stay pure-'data' collectives); an expert leaf riding
+                # 'data' updates in place (its grads were already
+                # summed by the all_to_all transpose — divide to the
+                # global-mean convention like reduce_grads does).
+                g_slices = (g_slices_acc if g_slices_acc is not None
+                            else scatter_tree(grads))
                 if clip_norm:
                     def slice_sumsq(spec, s):
                         # each slice holds distinct elements across
@@ -632,30 +826,27 @@ class Trainer:
                     g_slices = jax.tree_util.tree_map(
                         lambda s: s * factor, g_slices)
 
-                def pslice(spec, p):
-                    if DATA_AXIS in _spec_axes(spec):
-                        return p
-                    flat = _pad_flat(p, nd)
-                    k = flat.shape[0] // nd
-                    return lax.dynamic_slice_in_dim(flat, idx * k, k)
-
-                p_slices = jax.tree_util.tree_map(pslice, zspecs,
-                                                  state.params,
-                                                  is_leaf=is_p)
+                if zero_stage == 3:
+                    # params already live as slices — no re-slicing
+                    p_slices = state.params
+                else:
+                    p_slices = jax.tree_util.tree_map(
+                        lambda spec, p: zero_lib.slice_leaf(spec, p, nd,
+                                                            idx),
+                        zspecs, state.params, is_leaf=is_p)
                 updates, new_opt = self.tx.update(
                     g_slices, state.opt_state, p_slices, step=state.step)
                 new_slices = optax.apply_updates(p_slices, updates)
 
-                def gather(spec, ns, p):
-                    if DATA_AXIS in _spec_axes(spec):
-                        return ns.astype(p.dtype)
-                    full = lax.all_gather(ns, DATA_AXIS, axis=0,
-                                          tiled=True)
-                    return full[:p.size].reshape(p.shape).astype(p.dtype)
-
-                params = jax.tree_util.tree_map(gather, zspecs,
-                                                new_slices, state.params,
-                                                is_leaf=is_p)
+                if zero_stage == 3:
+                    # stay sliced: the NEXT step's per-leaf gather is
+                    # this stage's one param collective
+                    params = new_slices
+                else:
+                    params = jax.tree_util.tree_map(
+                        lambda spec, ns, p: zero_lib.gather_leaf(
+                            spec, ns, p.shape, p.dtype, nd, comm_off),
+                        zspecs, new_slices, state.params, is_leaf=is_p)
                 grads = g_slices  # the dynamic-scale finite check below
             else:
                 # DEVICE/NETWORK BOUNDARY: gradient all-reduce over the
@@ -721,7 +912,15 @@ class Trainer:
                 images = jnp.transpose(images, (0, 2, 3, 1))
             if normalize is not None:
                 images = normalize(images)
-            logits, _ = self._apply(state.params, state.batch_stats,
+            if zero_stage == 3:
+                eval_params = jax.tree_util.tree_map(
+                    lambda spec, sds, s: zero_lib.gather_leaf(
+                        spec, s, sds.shape, sds.dtype, nd, comm_off),
+                    param_specs, local_sds, state.params,
+                    is_leaf=zero_lib.is_spec)
+            else:
+                eval_params = state.params
+            logits, _ = self._apply(eval_params, state.batch_stats,
                                     images, train=False)
             per = compute_per_example_ce(logits, labels)  # [B] | [B,S/sp]
             w = mask[:, None] * jnp.ones_like(per) if per.ndim == 2 else mask
@@ -751,8 +950,13 @@ class Trainer:
             out_specs=(rep, rep, rep),
             check_vma=False)
 
+        if comm_off:
+            # the --zero_probe timing twin: returned, never installed,
+            # never donated (its caller reuses the live state)
+            return jax.jit(train_sharded)
         self.train_step = jax.jit(train_sharded, donate_argnums=(0,))
         self.eval_step = jax.jit(eval_sharded)
+        return None
 
     # ------------------------------------------------------------------
     def _compile_with_ledger(self, ledger, state, sharded):
@@ -770,6 +974,121 @@ class Trainer:
             return self.train_step
         ledger.register("train_step", compiled=compiled)
         return compiled
+
+    # ------------------------------------------------------------------
+    def _zero_overlap_probe(self, state: TrainState, batch, ledger,
+                            window_step_s) -> None:
+        """--zero_probe: turn the ZeRO-2/3 overlap claim into measured
+        numbers (obs/ledger + registry gauges, BENCH_zero's inputs).
+
+        Three measurements, all on the live mesh after training:
+          1. standalone per-leaf reduce-scatter / all-gather of the
+             param-shaped trees — the SERIALIZED collective wall, what
+             the step would pay if nothing overlapped;
+          2. a comm-stubbed twin of the compiled step (the same program
+             minus the data-axis ZeRO collectives) — its wall is the
+             step's compute+everything-else floor;
+          3. the run's own median clean-window step time.
+
+        exposed = max(0, step − twin) is the communication time the
+        schedule failed to hide; exposed / serialized is the
+        ``train_exposed_comm_frac`` gauge — strictly below 1.0 means
+        the overlap is real, not a cost-model assumption."""
+        mesh = self.rt.mesh
+        nd = mesh.shape[DATA_AXIS]
+        pspecs = self._zero_pspecs
+        local_sds = self._zero_local_sds
+        mesh_shape = dict(mesh.shape)
+        grad_slice_specs = jax.tree_util.tree_map(
+            zero_lib.zero_leaf_spec, pspecs, is_leaf=zero_lib.is_spec)
+        reduce_axes = (DATA_AXIS, SEQ_AXIS)
+
+        def scatter_local(p):
+            idx = lax.axis_index(DATA_AXIS)
+            return zero_lib.tree_map_specs(
+                lambda spec, g: zero_lib.scatter_leaf(
+                    spec, g.astype(jnp.float32), nd, reduce_axes,
+                    mesh_shape, False, idx),
+                pspecs, p)
+
+        def gather_local(s):
+            return zero_lib.tree_map_specs(
+                lambda spec, sds, leaf: zero_lib.gather_leaf(
+                    spec, leaf, sds.shape, sds.dtype, nd),
+                pspecs, local_sds, s)
+
+        scatter_fn = jax.jit(jax.shard_map(
+            scatter_local, mesh=mesh,
+            in_specs=(zero_lib.concrete_specs(pspecs),),
+            out_specs=zero_lib.concrete_specs(grad_slice_specs),
+            check_vma=False))
+        gather_fn = jax.jit(jax.shard_map(
+            gather_local, mesh=mesh,
+            in_specs=(zero_lib.concrete_specs(grad_slice_specs),),
+            out_specs=zero_lib.concrete_specs(pspecs),
+            check_vma=False))
+        # full-param-shaped probe input (values irrelevant): global
+        # shapes from the canonical template, placed per model specs
+        pshard = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            zero_lib.concrete_specs(pspecs),
+            is_leaf=lambda x: isinstance(x, P))
+        template = self._canonical_template.params
+        full = jax.jit(
+            lambda: jax.tree_util.tree_map(
+                lambda sds: jnp.zeros(sds.shape, sds.dtype), template),
+            out_shardings=pshard)()
+
+        def timed(fn, arg, repeats: int = 5) -> float:
+            jax.block_until_ready(fn(arg))  # compile outside the clock
+            walls = []
+            for _ in range(repeats):
+                t0 = time.monotonic()
+                jax.block_until_ready(fn(arg))
+                # dtflint: sync-point (probe timing — the measurement IS
+                # the sync)
+                walls.append(time.monotonic() - t0)
+            return sorted(walls)[len(walls) // 2]
+
+        scatter_s = timed(scatter_fn, full)
+        gather_s = timed(gather_fn, scatter_fn(full))
+        twin = self._build_steps(self._state_specs, comm_off=True)
+        twin_fn = lambda st: twin(st, *batch)[1]["loss"]
+        nocomm_s = timed(twin_fn, state, repeats=3)
+        step_s = sorted(window_step_s)[len(window_step_s) // 2]
+        # stage >= 2 pays one reduce-scatter per microbatch plus one
+        # param all-gather per step (stage 2: post-update; stage 3:
+        # pre-compute) — the wall those would cost SERIALIZED
+        serialized_s = self.grad_accum * scatter_s + gather_s
+        exposed_s = max(0.0, step_s - nocomm_s)
+        param_bytes = sum(
+            int(np.prod(sds.shape)) * jnp.dtype(sds.dtype).itemsize
+            for sds in jax.tree_util.tree_leaves(template))
+        ledger.register("zero_scatter", flops=0.0,
+                        bytes_accessed=float(param_bytes))
+        ledger.observe("zero_scatter", scatter_s)
+        ledger.register("zero_gather", flops=0.0,
+                        bytes_accessed=float(param_bytes))
+        ledger.observe("zero_gather", gather_s)
+        from dtf_tpu.obs.registry import default_registry
+        reg = default_registry()
+        reg.gauge("train_zero_scatter_wall_s", unit="s").set(scatter_s)
+        reg.gauge("train_zero_gather_wall_s", unit="s").set(gather_s)
+        reg.gauge("train_zero_comm_serialized_s",
+                  unit="s").set(serialized_s)
+        reg.gauge("train_zero_step_nocomm_s", unit="s").set(nocomm_s)
+        reg.gauge("train_exposed_comm_s", unit="s").set(exposed_s)
+        frac = exposed_s / serialized_s if serialized_s > 0 else 0.0
+        reg.gauge("train_exposed_comm_frac").set(frac)
+        trace.event("zero_overlap", zero_stage=self.zero_stage,
+                    scatter_wall_s=scatter_s, gather_wall_s=gather_s,
+                    serialized_s=serialized_s, step_s=step_s,
+                    nocomm_step_s=nocomm_s, exposed_s=exposed_s,
+                    exposed_frac=frac)
+        log.info("zero_probe: step %.2f ms, comm-off twin %.2f ms, "
+                 "exposed comm %.2f ms vs %.2f ms serialized "
+                 "(frac %.2f)", step_s * 1e3, nocomm_s * 1e3,
+                 exposed_s * 1e3, serialized_s * 1e3, frac)
 
     # ------------------------------------------------------------------
     def evaluate(self, state: TrainState, eval_iter: Iterator,
@@ -873,6 +1192,7 @@ class Trainer:
             _call(cb, "on_train_begin", None)
         eval_output = None
         metrics = None
+        last_sharded = None
         global_step = resumed_step
         start_epoch = (global_step // self.steps_per_epoch
                        if self.steps_per_epoch else 0)
@@ -911,6 +1231,7 @@ class Trainer:
                         sharded = (images, labels)
                     else:
                         sharded = self.rt.shard_batch((images, labels))
+                    last_sharded = sharded
                     # NOTE: jit dispatch is async — a "step" span measures
                     # host-side dispatch (sub-ms once compiled), which is
                     # what makes it cheap enough to emit every step.  It
@@ -972,6 +1293,17 @@ class Trainer:
                     for cb in callbacks:
                         _call(cb, "on_batch_end", batch_idx,
                               {"state": state, "step": global_step})
+                    ckpt_every = getattr(cfg, "checkpoint_steps", 0) or 0
+                    if ckpt_every and global_step % ckpt_every == 0:
+                        # an interval save just ran inside this log
+                        # window (synchronous seal — and under ZeRO
+                        # the canonical param/opt gather): skip the
+                        # window from the step-time signal like epoch
+                        # boundaries are, or train_step_s, the
+                        # step-time watchdog and the --zero_probe
+                        # exposed-comm number all absorb checkpoint
+                        # I/O as "step time"
+                        window_skewed = True
                     # chaos probe AFTER the interval checkpoint sealed:
                     # crash@step:K with checkpoint_steps dividing K is
                     # the deterministic kill-after-durable-save
@@ -1074,6 +1406,13 @@ class Trainer:
                  time.time() - t0, global_step)
         trace.event("train_end", step=global_step,
                     wall_s=time.time() - t0)
+        if (self.zero_stage >= 2 and getattr(cfg, "zero_probe", False)
+                and window_step_s and last_sharded is not None):
+            try:
+                self._zero_overlap_probe(state, last_sharded, ledger,
+                                         window_step_s)
+            except Exception:  # noqa: BLE001 — a probe must not fail a run
+                log.exception("zero_probe failed — overlap gauges skipped")
         ledger.emit_summary()
         trace.flush()
         # calibration gauges (dtf_tpu/plan reads these after a measured

@@ -1,0 +1,171 @@
+"""ZeRO weight-update sharding — the shared per-leaf layout + collective
+helpers behind stages 1-3 (PAPERS.md: Xu et al. 2020, arXiv 2004.13336).
+
+One layout contract, used by the train step (train/loop.py), the
+stage-3 parameter store, and the canonical-checkpoint conversions:
+
+  - a leaf already sharded over 'data' (MoE experts riding the batch
+    axis) keeps its full LOCAL shape — each data shard holds distinct
+    experts, there is nothing left to slice;
+  - every other leaf's ZeRO slice is a padded flat buffer: the LOCAL
+    (TP/PP) shard flattened, zero-padded to nd·k, and split into nd
+    chunks of k — PartitionSpec ('data',), composed with 'model' when
+    the param itself shards there (each (data, model) coordinate owns
+    one k-slice of its model shard).
+
+Everything here is a pure function of (PartitionSpec, leaf) and runs
+either inside ``shard_map`` (the collective forms) or as host-side
+shape math.  The padding rows are zeros at init and STAY zero under
+every supported optimizer (zero grads in, zero updates out — see
+optimizer.ZEROS_INIT_OPTIMIZERS), which is what makes dropping and
+re-creating them across a checkpoint round-trip exact.
+
+``comm_off=True`` variants replace each cross-'data' collective with a
+local op of the same output shape (values are garbage).  They exist
+for ONE purpose: the ``--zero_probe`` timing twin — a compiled step
+whose wall time is the step minus its data-axis collectives, so the
+EXPOSED (non-overlapped) communication time is a measured number
+rather than a model claim.  Never use a comm_off result as state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dtf_tpu.models.partition import spec_axes
+from dtf_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+class Replicated:
+    """Canonical-spec sentinel for leaves that are genuinely replicated
+    in BOTH layouts (the optimizer step count): distinguishes them from
+    replicated *params*, whose ZeRO slice is a flat ('data',) buffer."""
+
+
+REP = Replicated()
+
+
+def is_spec(x) -> bool:
+    return isinstance(x, (P, Replicated))
+
+
+def zero_leaf_spec(spec):
+    """ZeRO-slice PartitionSpec for one param-shaped leaf (the layout
+    the optimizer state — and stage-3 params — live in)."""
+    if isinstance(spec, Replicated):
+        return P()
+    axes = spec_axes(spec)
+    if DATA_AXIS in axes:
+        return spec
+    if MODEL_AXIS in axes:
+        return P((DATA_AXIS, MODEL_AXIS))
+    return P(DATA_AXIS)
+
+
+def pad_flat(p, nd: int):
+    """Flatten and zero-pad to a multiple of ``nd`` (the slice grid);
+    padding lives at the tail and is trimmed off after gather."""
+    flat = p.reshape(-1)
+    k = -(-flat.size // nd)
+    pad = nd * k - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def local_shape(spec, shape, mesh_shape) -> tuple:
+    """The shard_map-local shape of a leaf sharded by ``spec`` on a
+    mesh of ``mesh_shape`` (dims divided by their axis sizes)."""
+    if isinstance(spec, Replicated) or spec is None:
+        return tuple(shape)
+    out = list(shape)
+    for d, part in enumerate(spec):
+        if part is None:
+            continue
+        for a in (part if isinstance(part, (tuple, list)) else (part,)):
+            out[d] //= mesh_shape[a]
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# shard_map-local leaf ops (spec = the leaf's MODEL partition spec)
+# ---------------------------------------------------------------------------
+
+def slice_leaf(spec, p, nd: int, idx):
+    """This data shard's ZeRO slice of a local param leaf."""
+    if isinstance(spec, Replicated):
+        return p
+    if DATA_AXIS in spec_axes(spec):
+        return p
+    flat = pad_flat(p, nd)
+    k = flat.shape[0] // nd
+    return lax.dynamic_slice_in_dim(flat, idx * k, k)
+
+
+def gather_leaf(spec, s, shape, dtype, nd: int, comm_off: bool = False):
+    """Rebuild the full LOCAL leaf (``shape``/``dtype``) from its ZeRO
+    slice — the stage-3 per-leaf parameter all-gather (and the
+    canonical-checkpoint re-gather)."""
+    if isinstance(spec, Replicated):
+        return s
+    if DATA_AXIS in spec_axes(spec):
+        return s.astype(dtype)
+    if comm_off:
+        full = jnp.tile(s, nd)        # shape-right stand-in, no wire
+    else:
+        full = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
+    size = 1
+    for d in shape:
+        size *= d
+    return full[:size].reshape(shape).astype(dtype)
+
+
+def scatter_leaf(spec, g, nd: int, reduce_axes, mesh_shape,
+                 comm_off: bool = False, idx=None):
+    """Reduce-scatter one local grad leaf into this shard's f32 slice
+    (mean over the batch-splitting axes).  Leaves sharded over 'data'
+    (experts) keep their local shape: reverse-mode all_to_all already
+    summed their true grads, so they divide to the global-mean
+    convention instead of psum-ing."""
+    sharded = spec_axes(spec) if not isinstance(spec, Replicated) else set()
+    if DATA_AXIS in sharded:
+        axes = tuple(a for a in reduce_axes if a not in sharded)
+        if axes and not comm_off:
+            g = lax.pmean(g, axes)
+        denom = 1
+        for a in reduce_axes:
+            if a in sharded:
+                denom *= mesh_shape[a]
+        return (g / denom).astype(jnp.float32)
+    flat = pad_flat(g.astype(jnp.float32), nd)
+    if comm_off:
+        k = flat.shape[0] // nd
+        return lax.dynamic_slice_in_dim(flat, idx * k, k) / nd
+    s = lax.psum_scatter(flat, DATA_AXIS, scatter_dimension=0,
+                         tiled=True) / nd
+    return lax.pmean(s, SEQ_AXIS)
+
+
+def slice_zeros(spec, p, nd: int):
+    """f32 zeros shaped like ``scatter_leaf``'s output for a local leaf
+    ``p`` — the stage-2 sharded grad-accumulation carry."""
+    if not isinstance(spec, Replicated) and DATA_AXIS in spec_axes(spec):
+        return jnp.zeros(p.shape, jnp.float32)
+    k = -(-p.size // nd)
+    return jnp.zeros((k,), jnp.float32)
+
+
+def tree_map_specs(fn, specs, *trees):
+    """tree_map with PartitionSpec/Replicated leaves treated as leaves
+    of the spec tree."""
+    return jax.tree_util.tree_map(fn, specs, *trees, is_leaf=is_spec)
+
+
+def concrete_specs(specs):
+    """Replace Replicated sentinels with P() — the form shard_map's
+    in/out_specs and NamedSharding accept."""
+    return tree_map_specs(
+        lambda s: P() if isinstance(s, Replicated) else s, specs)

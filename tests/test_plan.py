@@ -119,7 +119,7 @@ def test_family_capabilities_mirror_runner():
 
 @pytest.mark.parametrize("kw", [
     dict(model=2, pipeline=2),   # both ride the 'model' mesh axis
-    dict(zero=2),                # this repo implements ZeRO-1
+    dict(zero=4),                # ZeRO stages end at 3
     dict(data=0),
     dict(microbatch=0),
 ])
@@ -217,6 +217,74 @@ def test_zero1_cuts_memory_not_time():
     saved = base.breakdown["opt_bytes"] - z.breakdown["opt_bytes"]
     assert saved == pytest.approx(
         base.breakdown["opt_bytes"] * (1 - 1 / 16))
+
+
+def test_zero2_shards_grads_and_zero3_shards_params():
+    """Stage-aware memory terms: stage 2 cuts the gradient buffer by
+    ~dp (sliced accumulator + one layer's transient — which needs the
+    accumulation scan, so microbatch > 1), stage 3 additionally slices
+    the persistent params (the gathered working copy is still counted
+    in full — honest accounting)."""
+    z1 = _cost(Plan(data=16, zero=1, microbatch=2))
+    z2 = _cost(Plan(data=16, zero=2, microbatch=2))
+    z3 = _cost(Plan(data=16, zero=3, microbatch=2))
+    # stage 2's whole point: the 2× full-grad accumulation buffer goes
+    assert z2.breakdown["grad_bytes"] < z1.breakdown["grad_bytes"] / 2
+    assert z2.peak_bytes < z1.peak_bytes
+    # stage 3 pays the gathered copy on top of its slices
+    assert z3.breakdown["param_term_bytes"] > \
+        z2.breakdown["param_term_bytes"]
+    # but opt + grads stay sliced, so z3 still beats replicated
+    assert z3.peak_bytes < _cost(Plan(data=16, microbatch=2)).peak_bytes
+
+
+def test_overlap_term_credits_only_differing_schedules():
+    """hidden = min(ov_share·comm, overlap_frac·compute), where only
+    the collectives whose SCHEDULE differs from the monolithic sync
+    earn credit: stage 2 at m=1 emits the SAME program as stage 1 and
+    must be priced identically; stage 3's pre-compute gathers earn
+    credit at any m; per-chunk scatters earn it only with m > 1."""
+    z1 = _cost(Plan(data=16, zero=1))
+    z2 = _cost(Plan(data=16, zero=2))
+    z3 = _cost(Plan(data=16, zero=3))
+    assert z1.breakdown["hidden_comm_s"] == 0.0
+    # m=1: stage 2 ≡ stage 1, time AND peak — identical programs
+    assert z2.breakdown["hidden_comm_s"] == 0.0
+    assert z2.step_time_s == z1.step_time_s
+    assert z2.peak_bytes == z1.peak_bytes
+    # stage 3's param gather hides behind the forward even at m=1
+    assert z3.breakdown["hidden_comm_s"] > 0.0
+    assert z3.step_time_s < z1.step_time_s
+    # with accumulation the per-chunk scatters earn credit too
+    z2_m2 = _cost(Plan(data=16, zero=2, microbatch=2))
+    assert z2_m2.breakdown["hidden_comm_s"] > 0.0
+    # per-microbatch scatters UNhidden cost more wire than one sync
+    z2_m4 = predict(Plan(data=16, zero=2, microbatch=4), FLAGSHIP, POD,
+                    256, optimizer="adamw", overlap_frac=0.0)
+    z1_m4 = predict(Plan(data=16, zero=1, microbatch=4), FLAGSHIP, POD,
+                    256, optimizer="adamw", overlap_frac=0.0)
+    assert z2_m4.breakdown["grad_sync_s"] > z1_m4.breakdown["grad_sync_s"]
+    with pytest.raises(ValueError, match="overlap_frac"):
+        predict(Plan(data=16, zero=2), FLAGSHIP, POD, 256,
+                optimizer="adamw", overlap_frac=1.5)
+
+
+def test_zero3_unlocks_a_config_replicated_cannot_fit():
+    """The headline window: a mesh where zero ∈ {0,1} is memory-
+    infeasible at ANY accumulation depth but zero=3 with a sharded
+    grad accumulator (microbatch > 1) fits — params+grads+opt
+    dominate, so slicing them over dp is the difference between
+    refusing and training."""
+    stats = characterize("transformer_tpu", seq_len=256, dtype_bytes=2)
+    mesh = mesh_spec("hosts=1,devices=16,hbm=1g,flops=140t")
+    for m in (1, 2):
+        for z in (0, 1):
+            c = predict(Plan(data=16, zero=z, remat=True, microbatch=m),
+                        stats, mesh, 16, optimizer="adamw")
+            assert not c.feasible, (z, m)
+    c3 = predict(Plan(data=16, zero=3, remat=True, microbatch=2),
+                 stats, mesh, 16, optimizer="adamw")
+    assert c3.feasible
 
 
 def test_remat_trades_activations_for_compute():
@@ -529,14 +597,24 @@ def test_plan_file_bit_identical_transformer_dp(tmp_path):
 @pytest.mark.slow
 def test_plan_auto_bit_identical_transformer_zero_mp(tmp_path):
     """Reference config 3: transformer_small under `--plan auto` on the
-    live 8-device mesh — the analytic winner at these shapes is
-    tensor-parallel + ZeRO-1 (TP divides the dominating grad-sync
-    volume; ZeRO breaks the equal-time tie by peak memory), so this
-    exercises the sharded-optimizer/model-parallel compile path."""
+    live 8-device mesh — the analytic winner at these shapes is now a
+    ZeRO-2/3 plan (the overlap term hides the per-microbatch grad
+    collectives behind compute, so the sharded stages outrank the
+    monolithic-sync ones), exercising the --zero_stage compile path
+    end to end through plan resolution."""
     cfg = _lm_cfg(plan="auto")
     hand = _assert_plan_run_bit_identical(tmp_path, cfg)
-    assert hand.model_parallelism > 1
-    assert hand.optimizer_sharding is True
+    assert hand.zero_stage_effective >= 2
+    # and the historical TP × ZeRO-1 point stays bit-identical when
+    # pinned explicitly via a plan file (the pre-overlap winner)
+    import json as json_lib
+    plan_file = tmp_path / "tp_zero1.json"
+    plan_file.write_text(json_lib.dumps(
+        {"plan": {"data": 4, "model": 2, "zero": 1}}))
+    cfg2 = _lm_cfg(plan=str(plan_file))
+    hand2 = _assert_plan_run_bit_identical(tmp_path / "pinned", cfg2)
+    assert hand2.model_parallelism > 1
+    assert hand2.optimizer_sharding is True
 
 
 # ---------------------------------------------------------------------------
@@ -602,6 +680,57 @@ def test_plan_cache_hit_reproduces_search_and_keys_strictly(tmp_path):
             == [r.to_dict() for r in fresh])
     _, hit6 = cached_search(path, stats, mesh, 8)   # rewritten after
     assert hit6
+
+
+def test_plan_cache_stale_version_recomputes(tmp_path):
+    """A cache entry written under an older CACHE_VERSION (a previous
+    cost-model formula) must be RECOMPUTED, never served: the version
+    is part of both the per-entry key and the file header, so a
+    formula change cannot silently resurrect an old ranking."""
+    import json as json_lib
+
+    from dtf_tpu.plan import cache as cache_mod
+    from dtf_tpu.plan.cache import cache_key, cached_search
+    from dtf_tpu.plan.compile import stats_for_config
+    from dtf_tpu.plan.mesh_spec import mesh_spec
+
+    cfg = Config(model="transformer_small", dataset="lm", batch_size=8,
+                 seq_len=64)
+    stats = stats_for_config(cfg)
+    mesh = mesh_spec("cpu")
+    path = str(tmp_path / "plan_cache.json")
+    fresh, hit = cached_search(path, stats, mesh, 8)
+    assert not hit
+
+    # forge the file a PREVIOUS version would have written: same
+    # workload, keyed and stamped with CACHE_VERSION-1, carrying a
+    # poisoned ranking that today's formula would never produce
+    with open(path) as f:
+        doc = json_lib.load(f)
+    (cur_key, entry), = doc["entries"].items()
+    poisoned = dict(entry)
+    poisoned["ranked"] = entry["ranked"][:1]
+    try:
+        cache_mod.CACHE_VERSION -= 1
+        old_key, _ = cache_key(stats, mesh, 8, "sgd")
+    finally:
+        cache_mod.CACHE_VERSION += 1
+    assert old_key != cur_key       # the version IS part of the key
+    stale = {"cache_version": cache_mod.CACHE_VERSION - 1,
+             "entries": {old_key: poisoned}}
+    with open(path, "w") as f:
+        json_lib.dump(stale, f)
+
+    recomputed, hit2 = cached_search(path, stats, mesh, 8)
+    assert not hit2                 # stale version = miss, not serve
+    assert len(recomputed) == len(fresh) > 1
+    assert ([r.to_dict() for r in recomputed]
+            == [r.to_dict() for r in fresh])
+    # and the rewritten sidecar is current-version (stale entry gone)
+    with open(path) as f:
+        rewritten = json_lib.load(f)
+    assert rewritten["cache_version"] == cache_mod.CACHE_VERSION
+    assert old_key not in rewritten["entries"]
 
 
 def test_plan_main_uses_cache_on_repeat(tmp_path):
@@ -688,7 +817,7 @@ def test_calibrate_resets_plan_owned_flags(monkeypatch):
     plan = plan_from_config(cfg, mesh.num_devices)
     assert plan.microbatch == 2 and plan.remat
     rc = plan_main._calibrate(cfg, stats_for_config(cfg), mesh, plan,
-                              steps=2, tolerance=1e9)
+                              steps=2, tolerance=1e9, overlap_frac=0.5)
     assert rc == 0
     # the smoke ran with the SAME hand-set levers, via the plan
     assert seen["cfg"].grad_accum_steps == 2

@@ -548,6 +548,57 @@ def test_replicas_for_can_fail_loudly():
     assert n is None and len(evaluated) == 3
 
 
+def test_cost_per_token_ranking():
+    """$/Mtoken at the SLO (capacity-sim follow-on #4): the dollar
+    arithmetic is rate/throughput, halving service time ~halves
+    $/token, and an SLO-missing config ranks below every meeting one
+    no matter how cheap its tokens are."""
+    from dtf_tpu.plan.serve_model import rank_cost_per_token
+
+    w = synthetic_workload(rate_rps=20, duration_s=20, seed=5,
+                           prompt_tokens=(16, 48), decode_tokens=24)
+    base = dataclasses.replace(CONFIG, slots=4, pool_pages=40)
+    rows = rank_cost_per_token(w, PROFILE, base, chips=4,
+                               chip_cost_per_hour=3.6, slo_p99_s=5.0)
+    assert [(r.config.tp, r.config.replicas) for r in rows] \
+        == [(r.config.tp, r.config.replicas)
+            for r in sorted(rows, key=lambda r: (not r.meets_slo,
+                                                 r.usd_per_mtoken))]
+    top = rows[0]
+    assert top.meets_slo
+    # the dollar arithmetic: chips × $/chip-hr / 3600 / tok/s × 1e6
+    expect = 4 * 3.6 / 3600.0 / top.prediction.tokens_per_s * 1e6
+    assert top.usd_per_mtoken == pytest.approx(expect)
+    assert top.usd_per_hour == pytest.approx(4 * 3.6)
+    # a faster profile cuts $/token — visible once the fleet (not the
+    # arrival process) is the throughput bound, so saturate it
+    sat = synthetic_workload(rate_rps=200, duration_s=10, seed=5,
+                             prompt_tokens=(16, 48), decode_tokens=24)
+    slow_sat = rank_cost_per_token(sat, PROFILE, base, chips=4,
+                                   chip_cost_per_hour=3.6,
+                                   slo_p99_s=1e9, loss_bar=1.0)
+    fast = dataclasses.replace(PROFILE, decode_step_s=0.005)
+    fast_sat = rank_cost_per_token(sat, fast, base, chips=4,
+                                   chip_cost_per_hour=3.6,
+                                   slo_p99_s=1e9, loss_bar=1.0)
+    assert fast_sat[0].usd_per_mtoken < 0.7 * slow_sat[0].usd_per_mtoken
+    # an impossible SLO: nothing meets it, everything ranked anyway
+    none_meet = rank_cost_per_token(w, PROFILE, base, chips=4,
+                                    chip_cost_per_hour=3.6,
+                                    slo_p99_s=1e-4)
+    assert not any(r.meets_slo for r in none_meet)
+    # SLO dominance: the json form keeps strict-JSON costs
+    assert all((r.to_dict()["usd_per_mtoken"] is None)
+               == (r.usd_per_mtoken == float("inf"))
+               for r in none_meet)
+    with pytest.raises(ValueError, match="chip_cost_per_hour"):
+        rank_cost_per_token(w, PROFILE, base, chips=4,
+                            chip_cost_per_hour=0.0, slo_p99_s=5.0)
+    with pytest.raises(ValueError, match="slo_p99_s"):
+        rank_cost_per_token(w, PROFILE, base, chips=4,
+                            chip_cost_per_hour=1.0, slo_p99_s=0.0)
+
+
 # ---------------------------------------------------------------------------
 # calibration
 # ---------------------------------------------------------------------------

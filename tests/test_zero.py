@@ -325,3 +325,45 @@ def test_zero_e2e_cli():
                        log_steps=1, distribution_strategy="mirrored",
                        num_devices=2, optimizer_sharding=True))
     assert np.isfinite(stats["loss"])
+
+
+def test_zero2_e2e_cli():
+    """--zero_stage 2 (sharded grads) through the full run() path."""
+    stats = run(Config(model="resnet20", dataset="cifar10", batch_size=8,
+                       train_steps=2, use_synthetic_data=True,
+                       skip_eval=True, skip_checkpoint=True, model_dir="",
+                       log_steps=1, distribution_strategy="mirrored",
+                       num_devices=2, zero_stage=2, grad_accum_steps=2))
+    assert np.isfinite(stats["loss"])
+
+
+@pytest.mark.slow
+def test_zero23_compose_with_tp(tiny_transformer_registry):
+    """Stages 2/3 × tensor parallelism: sharded-grad accumulation and
+    sliced params compose with the Megatron layout — same trajectory
+    as plain TP (and the ZeRO-1 pin above)."""
+    tp = run(_lm_cfg(model_parallelism=2, num_devices=8))
+    for stage in (2, 3):
+        z = run(_lm_cfg(model_parallelism=2, num_devices=8,
+                        zero_stage=stage))
+        np.testing.assert_allclose(tp["loss"], z["loss"], rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_zero3_composes_with_ep(tiny_moe_registry):
+    """Stage 3 × expert parallelism: expert leaves ride 'data' and stay
+    locally shaped (nothing to gather) — identity vs plain EP."""
+    ep = run(_moe_cfg(num_devices=4))
+    z = run(_moe_cfg(num_devices=4, zero_stage=3))
+    np.testing.assert_allclose(ep["loss"], z["loss"], rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_zero3_composes_with_pp(tiny_pipe_registry):
+    """Stage 3 × pipeline stages: stage-stacked leaves slice their
+    local stack over 'data' and gather per step — identity vs PP."""
+    pp = run(_lm_cfg(model="pipeline_transformer", model_parallelism=4,
+                     num_devices=8, num_microbatches=2))
+    z = run(_lm_cfg(model="pipeline_transformer", model_parallelism=4,
+                    num_devices=8, num_microbatches=2, zero_stage=3))
+    np.testing.assert_allclose(pp["loss"], z["loss"], rtol=1e-5)

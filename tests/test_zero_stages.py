@@ -1,0 +1,251 @@
+"""ZeRO stages 2/3 (--zero_stage): sharded gradients / sharded params
+on the data axis, and the canonical-checkpoint contract that makes the
+stages interchangeable.
+
+Every stage is mathematically plain data parallelism, so the parity
+tests demand the documented float tolerance (reassociation of the
+reduce-scatter vs the all-reduce is the only difference).  Checkpoints
+are always WRITTEN in the stage-0 layout (Trainer.canonical_state), so
+the matrix here pins: save at stage A → restore at stage B continues
+the exact stage-0 trajectory, for every interesting (A, B) — and a
+stage-3 checkpoint loads into serving via the bridge's structure-free
+restore with full-shaped params.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import dtf_tpu.data.base as data_base
+from dtf_tpu.cli import run
+from dtf_tpu.config import Config
+from dtf_tpu.models import build_model
+from dtf_tpu.runtime import initialize
+from dtf_tpu.runtime.mesh import DATA_AXIS
+from dtf_tpu.train import Trainer
+
+TINY = dataclasses.replace(data_base.CIFAR10, image_size=8, num_train=64,
+                           num_eval=16)
+
+
+@pytest.fixture(autouse=True)
+def tiny_specs(monkeypatch):
+    monkeypatch.setitem(data_base._SPECS, "cifar10", TINY)
+
+
+def _cfg(model_dir, stage, steps, **kw):
+    kw.setdefault("checkpoint_steps", 2)
+    return Config(model="resnet20", dataset="cifar10", batch_size=8,
+                  train_steps=steps, use_synthetic_data=True,
+                  skip_eval=True, model_dir=model_dir, log_steps=1,
+                  distribution_strategy="mirrored", num_devices=4,
+                  zero_stage=stage if stage != 1 else 0,
+                  optimizer_sharding=stage == 1, **kw)
+
+
+def test_zero_stage_flag_validation():
+    with pytest.raises(ValueError, match="zero_stage"):
+        Config(zero_stage=4)
+    with pytest.raises(ValueError, match="optimizer_sharding"):
+        Config(optimizer_sharding=True, zero_stage=2)
+    with pytest.raises(ValueError, match="zero_probe"):
+        Config(zero_probe=True)  # needs stage >= 2
+    assert Config(zero_stage=2).zero_stage_effective == 2
+    assert Config(optimizer_sharding=True).zero_stage_effective == 1
+    assert Config().zero_stage_effective == 0
+
+
+def _trainer(stage, num_devices=4):
+    cfg = _cfg("", stage, 1, checkpoint_steps=0, skip_checkpoint=True)
+    cfg = cfg.replace(num_devices=num_devices)
+    rt = initialize(cfg)
+    model, l2 = build_model("resnet20")
+    trainer = Trainer(cfg, rt, model, l2, TINY, schedule=lambda s: 0.1)
+    rng = np.random.default_rng(0)
+    images = rng.normal(120, 50, (8, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, (8,)).astype(np.int32)
+    state = trainer.init_state(jax.random.key(0), (images, labels))
+    return trainer, rt, state, (images, labels)
+
+
+def test_zero3_params_are_sliced_and_canonical_roundtrips(eight_devices):
+    """The point of stage 3: params live as 1/nd flat slices over
+    'data'; the canonical conversion re-gathers full shapes and the
+    staged inverse reproduces the slices BIT-identically (what makes
+    the checkpoint matrix exact)."""
+    trainer, rt, state, batch = _trainer(3)
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.ndim == 1                       # flat slices
+        assert leaf.sharding.spec == P(DATA_AXIS)
+        assert leaf.shape[0] % 4 == 0               # padded to nd
+    canon = trainer.canonical_state(state)
+    # canonical params are the MODEL's shapes (conv kernels are 4-D)
+    dims = {leaf.ndim
+            for leaf in jax.tree_util.tree_leaves(canon.params)}
+    assert 4 in dims
+    staged = trainer.staged_state(jax.device_get(canon))
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(staged)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+    # and the step runs on the sliced layout
+    state, metrics = trainer.train_step(state, *rt.shard_batch(batch))
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
+@pytest.mark.slow
+def test_stage23_match_plain_dp(eight_devices):
+    """Per-step loss parity: stages 2 and 3 ≡ stage 0, with and
+    without sharded grad accumulation."""
+    def final_loss(stage, accum):
+        cfg = _cfg("", stage, 2, checkpoint_steps=0,
+                   skip_checkpoint=True).replace(grad_accum_steps=accum)
+        rt = initialize(cfg)
+        model, l2 = build_model("resnet20")
+        trainer = Trainer(cfg, rt, model, l2, TINY,
+                          schedule=lambda s: 0.1)
+        rng = np.random.default_rng(1)
+        images = rng.normal(120, 50, (8, 8, 8, 3)).astype(np.float32)
+        labels = rng.integers(0, 10, (8,)).astype(np.int32)
+        state = trainer.init_state(jax.random.key(0), (images, labels))
+        batch = rt.shard_batch((images, labels))
+        for _ in range(2):
+            state, m = trainer.train_step(state, *batch)
+        return float(jax.device_get(m["loss"]))
+
+    for accum in (1, 2):
+        ref = final_loss(0, accum)
+        for stage in (2, 3):
+            np.testing.assert_allclose(final_loss(stage, accum), ref,
+                                       rtol=1e-5)
+
+
+# save-stage → restore-stage pairs covering every conversion direction
+# (full↔sliced params, full↔sliced opt state, same-stage identity)
+MATRIX = [(0, 3), (3, 0), (2, 3), (3, 2), (1, 2), (3, 3)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("save_stage,restore_stage", MATRIX)
+def test_checkpoint_matrix_cross_stage_trajectory_exact(
+        tmp_path, eight_devices, save_stage, restore_stage):
+    """Save at stage A (canonical layout on disk), restore at stage B,
+    train on: the final loss equals the uninterrupted stage-0 run's —
+    the stages are one training process with different layouts."""
+    ref = run(_cfg(str(tmp_path / "ref"), 0, 4))
+    run(_cfg(str(tmp_path / "x"), save_stage, 2))
+    out = run(_cfg(str(tmp_path / "x"), restore_stage, 4,
+                   resume=True))
+    np.testing.assert_allclose(out["loss"], ref["loss"], rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_zero3_checkpoint_serves_via_bridge(tmp_path, eight_devices):
+    """A stage-3 run's checkpoint loads through the serve bridge's
+    structure-free restore with FULL-shaped params (the canonical
+    layout) — token-for-token equal to the same seed's stage-0
+    checkpoint."""
+    from dtf_tpu.train.checkpoint import load_train_checkpoint
+    run(_cfg(str(tmp_path / "z3"), 3, 2))
+    run(_cfg(str(tmp_path / "z0"), 0, 2))
+    v3 = load_train_checkpoint(str(tmp_path / "z3"))
+    v0 = load_train_checkpoint(str(tmp_path / "z0"))
+    assert v3 is not None and v0 is not None
+    l3 = dict(jax.tree_util.tree_leaves_with_path(v3["params"]))
+    l0 = dict(jax.tree_util.tree_leaves_with_path(v0["params"]))
+    assert set(l3) == set(l0)
+    for path, a in l0.items():
+        assert np.asarray(a).shape == np.asarray(l3[path]).shape
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(l3[path]),
+                                   atol=2e-6, rtol=1e-5,
+                                   err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.slow
+def test_zero_resume_layout_mismatch_is_loud(tmp_path, eight_devices):
+    """A checkpoint that VERIFIES (sha256-intact) but cannot restore
+    into the canonical ZeRO template (layout mismatch — e.g. written
+    by a different optimizer config, or a pre-canonical-format ZeRO
+    run) must raise, not silently restart from step 0."""
+    run(_cfg(str(tmp_path), 0, 2))  # sgd stage-0 checkpoint
+    with pytest.raises(ValueError, match="canonical ZeRO checkpoint"):
+        run(_cfg(str(tmp_path), 3, 4, resume=True)
+            .replace(optimizer="adamw"))
+
+
+@pytest.mark.slow
+def test_zero3_killed_at_k_resumes_bit_identical(tmp_path):
+    """The PR-4 chaos path under ZeRO-3: an injected crash@step:4 under
+    the launch_local supervisor, resumed through the canonical-
+    checkpoint restore, reproduces the uninterrupted run's per-step
+    loss trajectory BIT-identically — sliced params/optimizer state
+    round-trip through the stage-0 wire format without a single ulp."""
+    import glob
+    import json
+    import subprocess
+    import sys
+
+    from dtf_tpu.cli.launch import launch_local
+
+    def train_cmd(model_dir, trace_dir, extra=()):
+        return [sys.executable, "-m", "dtf_tpu.cli.lm_main",
+                "--use_synthetic_data", "--model", "transformer_small",
+                "--seq_len", "64", "--batch_size", "4",
+                "--train_steps", "6", "--log_steps", "1",
+                "--skip_eval", "--verbose", "0",
+                "--step_time_guard_factor", "0",
+                "--num_devices", "4", "--zero_stage", "3",
+                "--model_dir", model_dir, "--trace_dir", trace_dir,
+                *extra]
+
+    def loss_by_step(trace_dir):
+        out = {}
+        for path in glob.glob(str(trace_dir) + "/trace_rank*.jsonl"):
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("kind") == "event" and \
+                            rec.get("name") == "train_loss":
+                        out.setdefault(int(rec["step"]),
+                                       set()).add(rec["loss"])
+        return out
+
+    r = subprocess.run(train_cmd(str(tmp_path / "m0"),
+                                 str(tmp_path / "t0")), timeout=900)
+    assert r.returncode == 0
+    baseline = loss_by_step(tmp_path / "t0")
+    assert set(baseline) == set(range(1, 7))
+
+    rc = launch_local(
+        train_cmd(str(tmp_path / "m1"), str(tmp_path / "t1"),
+                  extra=("--resume", "--checkpoint_steps", "2",
+                         "--fault", "crash@step:4")),
+        num_processes=1, coordinator="localhost:0",
+        log_dir=str(tmp_path / "logs"), devices_per_process=None,
+        max_restarts=2, restart_backoff_s=0.1)
+    assert rc == 0
+    got = loss_by_step(tmp_path / "t1")
+    assert set(got) == set(baseline)
+    for step in sorted(baseline):
+        assert got[step] == baseline[step], (
+            f"step {step}: {sorted(got[step])} != "
+            f"{sorted(baseline[step])}")
+
+
+@pytest.mark.slow
+def test_zero_smoke_tool():
+    """tools/zero_smoke.py — the ci_check stage-14 contract — as a
+    slow-marked test so the suite exercises it too."""
+    import subprocess
+    import sys
+    r = subprocess.run([sys.executable, "tools/zero_smoke.py",
+                        "--fast"], capture_output=True, text=True,
+                       timeout=1500)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

@@ -15,6 +15,9 @@ What it reads (all committed at the repo root):
                        tps_min/tps_max) spread fields.
   BENCH_serve*.json  — bench_serve.py --out artifacts: a "metrics"
                        list of BenchmarkMetric lines + "bars_failed".
+  BENCH_zero*.json   — tools/zero_smoke.py --out artifacts: the ZeRO
+                       overlap/calibration gauges as a "metrics" list
+                       + "bars_failed" (same shape as serve).
 
 Thresholds (documented contract, deliberately simple):
   * baseline per metric = the newest HISTORICAL artifact carrying it
@@ -133,19 +136,22 @@ def load_artifact(path: str) -> Tuple[Dict[str, dict], List[str]]:
 
 def default_history() -> List[str]:
     pats = (os.path.join(REPO, "BENCH_r*.json"),
-            os.path.join(REPO, "BENCH_serve*.json"))
+            os.path.join(REPO, "BENCH_serve*.json"),
+            os.path.join(REPO, "BENCH_zero*.json"))
     return sorted(p for pat in pats for p in glob.glob(pat))
 
 
 def families(history: List[str]) -> Dict[str, List[str]]:
-    """Group artifacts into tracked families (training BENCH_r* vs
-    serving BENCH_serve*) so the default/smoke modes gate the newest
-    artifact of EACH family — a lexicographic history[-1] would
-    permanently pick the serve family once one is committed and stop
-    gating the training claims entirely."""
+    """Group artifacts into tracked families (training BENCH_r*,
+    serving BENCH_serve*, ZeRO-overlap BENCH_zero*) so the default/
+    smoke modes gate the newest artifact of EACH family — a
+    lexicographic history[-1] would permanently pick one family once
+    committed and stop gating the others' claims entirely."""
     out: Dict[str, List[str]] = {}
     for path in history:
-        fam = ("serve" if os.path.basename(path).startswith("BENCH_serve")
+        base = os.path.basename(path)
+        fam = ("serve" if base.startswith("BENCH_serve")
+               else "zero" if base.startswith("BENCH_zero")
                else "train")
         out.setdefault(fam, []).append(path)
     return {fam: sorted(paths) for fam, paths in out.items()}
