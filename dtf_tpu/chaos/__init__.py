@@ -50,6 +50,23 @@ Kinds and their firing semantics:
                           checkpoint step before the next restore
                           (one-shot) — exercises the integrity manifest
                           fallback to the previous verified step.
+  device_loss@step:N      the rank's accelerators vanish at the step-N
+                          boundary (exact match): the process exits
+                          EXIT_DEVICE_LOST (76) — the host survives but
+                          its chips are gone (a pod-slice preemption, a
+                          PCIe/ICI fault).  Under `launch.py --elastic`
+                          the supervisor RESHARDS: it relaunches on the
+                          surviving topology at the last checkpoint
+                          instead of burning the crash-restart budget.
+  host_loss@[rankK:]step:N  the whole host vanishes at the step-N
+                          boundary (exact match): the rank SIGKILLs
+                          itself — death by an UNPROMPTED SIGKILL, the
+                          rank-exit pattern of a host disappearing
+                          (OOM-killer, infra teardown), which the
+                          supervisor classifies as host loss (no python
+                          crash exits via SIGKILL on its own).  Elastic
+                          supervisors drop the lost host's devices and
+                          resume smaller.
   reader_crash@batch:N    SIGKILLs the data-service shard worker that
                           owns merged batch N, as the consumer reaches
                           that batch (exact match, one-shot) — the
@@ -109,14 +126,20 @@ log = logging.getLogger("dtf_tpu")
 # Exit-code contract with the launch.py supervisor (which is stdlib-only
 # by design and carries its own copy; parity is test-pinned).
 EXIT_PREEMPTED = 75        # EX_TEMPFAIL: graceful preemption checkpoint
+EXIT_DEVICE_LOST = 76      # accelerators gone, host alive: the elastic
+                           # supervisor reshards instead of budgeting it
+                           # as a crash (train/elastic.py owns the
+                           # canonical constant; parity test-pinned)
 EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
 
 KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
          "reader_crash", "replica_kill", "net_partition", "slow_replica",
-         "rollout_kill")
+         "rollout_kill", "device_loss", "host_loss")
 _POINTS = {
     "crash": "step",
     "sigterm": "step",
+    "device_loss": "step",
+    "host_loss": "step",
     "heartbeat_stall": "step",
     "ps_drop": "version",
     "ckpt_truncate": "latest",
@@ -295,6 +318,22 @@ class Injector:
                     # the preemption signal, delivered for real so the
                     # actual production handler path runs
                     os.kill(os.getpid(), signal.SIGTERM)
+            for spec in self._armed("device_loss"):
+                if step == spec.value:
+                    self._record(spec, step=step)
+                    # accelerator loss: the runtime is gone but the host
+                    # can still report it — the distinct exit code the
+                    # elastic supervisor reshards on (no atexit/finally,
+                    # like a runtime abort)
+                    os._exit(EXIT_DEVICE_LOST)
+            for spec in self._armed("host_loss"):
+                if step == spec.value:
+                    self._record(spec, step=step)
+                    # the whole host vanishes: death by SIGKILL, which
+                    # the supervisor reads as an UNPROMPTED kill (the
+                    # host-loss rank-exit pattern — a python crash
+                    # cannot exit via SIGKILL by itself)
+                    os.kill(os.getpid(), signal.SIGKILL)
 
     def heartbeat_stalled(self, step: Optional[int]) -> bool:
         """True once a heartbeat_stall fault latched (permanent: a

@@ -46,15 +46,33 @@ from typing import List, Optional
 # tests/test_obs.py asserts the two sides agree on the contract.
 HEARTBEAT_DIR_ENV = "DTF_HEARTBEAT_DIR"
 
-# Exit-code contract with dtf_tpu/train/preemption.py and dtf_tpu/chaos
-# — duplicated here for the same stdlib-only reason (parity is pinned
-# by tests/test_chaos.py).  A rank exiting EXIT_PREEMPTED performed a
+# Exit-code contract with dtf_tpu/train/preemption.py, dtf_tpu/chaos
+# and dtf_tpu/train/elastic.py — duplicated here for the same
+# stdlib-only reason (parity is pinned by tests/test_chaos.py and
+# tests/test_elastic.py).  A rank exiting EXIT_PREEMPTED performed a
 # graceful preemption checkpoint: the supervisor restarts it WITHOUT
 # consuming the crash-restart budget and without backoff (the work is
-# durable; waiting helps nobody).  Any other nonzero exit (including
-# death by signal — negative Popen returncodes) is a crash: budgeted,
-# with exponential backoff.
+# durable; waiting helps nobody).  A rank exiting EXIT_DEVICE_LOST saw
+# its accelerators vanish while the host survived: under --elastic the
+# supervisor RESHARDS (relaunch on the surviving topology) instead of
+# burning the crash budget on a fault no restart-at-size can fix.  Any
+# other nonzero exit (including death by signal — negative Popen
+# returncodes) is a crash: budgeted, with exponential backoff — except
+# an UNPROMPTED SIGKILL (one this supervisor did not send), which is
+# the host-loss rank-exit pattern: the OOM-killer or the host going
+# away, never a python crash.
 EXIT_PREEMPTED = 75
+EXIT_DEVICE_LOST = 76
+
+# Env var + rendezvous-file contract with dtf_tpu/train/elastic.py
+# (canonical constants live there; parity test-pinned).  The supervisor
+# exports the surviving device total so a relaunched rank can verify
+# the topology it actually attached matches the supervisor's
+# accounting; a healed host's agent (or the elastic smoke) re-announces
+# capacity by writing {"devices": N} into <log_dir>/elastic_rejoin.json
+# — the grow-back probe consumes it at the next checkpoint boundary.
+ELASTIC_DEVICES_ENV = "DTF_ELASTIC_DEVICES"
+REJOIN_FILE = "elastic_rejoin.json"
 
 
 def classify_exit(rc: int) -> str:
@@ -62,7 +80,24 @@ def classify_exit(rc: int) -> str:
         return "ok"
     if rc == EXIT_PREEMPTED:
         return "preempted"
+    if rc == EXIT_DEVICE_LOST:
+        return "device_loss"
     return "crash"
+
+
+def read_rejoin(log_dir: str):
+    """Announced rejoin capacity (device count), or None when absent,
+    torn, or malformed — ANY unreadable announce reads as 'not yet',
+    never as a grow (and never as a supervisor crash: this runs inside
+    the monitor loop)."""
+    try:
+        with open(os.path.join(log_dir, REJOIN_FILE)) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            return None
+        return int(doc.get("devices", 0))
+    except (OSError, ValueError, TypeError):
+        return None
 
 
 class SupervisorEventLog:
@@ -104,7 +139,8 @@ def build_env(rank: int, world: int, coordinator: str,
               devices_per_process: Optional[int] = None,
               heartbeat_dir: Optional[str] = None,
               generation: int = 0,
-              trace_id: Optional[str] = None) -> dict:
+              trace_id: Optional[str] = None,
+              elastic_devices: Optional[int] = None) -> dict:
     env = dict(os.environ)
     env["DTF_COORDINATOR"] = coordinator
     env["DTF_PROCESS_ID"] = str(rank)
@@ -133,6 +169,13 @@ def build_env(rank: int, world: int, coordinator: str,
         # (obs/watchdog.Heartbeat) — the supervisor's structured
         # liveness signal, replacing stdout-size scraping
         env[HEARTBEAT_DIR_ENV] = os.path.abspath(heartbeat_dir)
+    if elastic_devices:
+        # elastic supervision: the surviving device TOTAL this attempt
+        # was sized for — the runner verifies its attached topology
+        # against it (train/elastic.note_elastic_resume) so a relaunch
+        # that silently got a different mesh than the supervisor
+        # accounted for fails loudly instead of training mis-sharded
+        env[ELASTIC_DEVICES_ENV] = str(elastic_devices)
     if devices_per_process:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count="
@@ -147,11 +190,21 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
               attempt: int = 0, startup_grace: float = 300.0,
               events: Optional[SupervisorEventLog] = None,
               teardown_grace: float = 60.0,
-              trace_id: Optional[str] = None) -> int:
+              trace_id: Optional[str] = None,
+              grow_check=None,
+              elastic_devices: Optional[int] = None):
+    """One supervised attempt.  Returns ``(rc, classification, grew)``:
+    the first failing rank's exit code and REFINED classification
+    (heartbeat-lost kills and unprompted SIGKILLs read as host loss,
+    EXIT_DEVICE_LOST as device loss), and whether ``grow_check`` fired
+    — in which case the attempt was deliberately drained (SIGTERM ⇒
+    emergency checkpoints ⇒ the preempted exit) so the caller can
+    relaunch at the restored topology."""
     os.makedirs(log_dir, exist_ok=True)
     if events is None:
         events = SupervisorEventLog(log_dir)
-    events.emit("attempt_start", attempt=attempt, ranks=num_processes)
+    events.emit("attempt_start", attempt=attempt, ranks=num_processes,
+                devices_per_process=devices_per_process)
     # teardown escalation state: once a failure SIGTERMs the survivors,
     # they get `teardown_grace` seconds to emergency-checkpoint and
     # exit; a rank wedged in a dead collective (or ignoring SIGTERM)
@@ -161,6 +214,13 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
     procs = []  # (rank, Popen)
     logs = []
     rc = 0
+    first_cls = "ok"
+    grew = False
+    # kill attribution for host-loss classification: ranks THIS
+    # supervisor SIGKILLed (heartbeat loss, teardown escalation) vs an
+    # unprompted SIGKILL from outside (OOM-killer, the host vanishing)
+    hb_killed: set = set()
+    td_killed: set = set()
     # hang watchdog state: last time each rank showed life — via its
     # heartbeat file (structured, preferred) or its log growing
     # (fallback ONLY for ranks that have never emitted a heartbeat: once
@@ -191,7 +251,8 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                                    devices_per_process,
                                    heartbeat_dir=log_dir,
                                    generation=attempt,
-                                   trace_id=trace_id),
+                                   trace_id=trace_id,
+                                   elastic_devices=elastic_devices),
                 stdout=f, stderr=subprocess.STDOUT)
             procs.append((rank, p))
             last_beat[rank] = spawned[rank] = time.monotonic()
@@ -253,17 +314,34 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                             events.emit("heartbeat_lost", attempt=attempt,
                                         rank=rank,
                                         timeout_s=heartbeat_timeout)
+                            # heartbeat silence is the host-loss
+                            # signature (a dead host stops beating long
+                            # before any exit code arrives) — remember
+                            # the kill so the exit classifies as
+                            # host_loss, not as our own SIGKILL
+                            hb_killed.add(rank)
                             p.kill()
                     continue
                 procs.remove((rank, p))
+                cls = classify_exit(ret)
+                if rank in hb_killed:
+                    cls = "host_loss"
+                elif (ret < 0 and -ret == signal.SIGKILL
+                        and rank not in td_killed):
+                    # an unprompted SIGKILL: this supervisor did not
+                    # send it, and a python crash cannot exit via
+                    # SIGKILL on its own — the OOM-killer or the host
+                    # going away, i.e. host loss
+                    cls = "host_loss"
                 events.emit("rank_exit", attempt=attempt, rank=rank,
-                            code=ret, classification=classify_exit(ret),
+                            code=ret, classification=cls,
                             log=log_path(rank))
                 if ret != 0:
-                    if rc == 0:  # keep the FIRST failure's code
+                    if rc == 0:  # keep the FIRST failure's code + class
                         rc = ret
+                        first_cls = cls
                     print(f"rank {rank} exited {ret} "
-                          f"({classify_exit(ret)}; see "
+                          f"({cls}; see "
                           f"{log_path(rank)}); tearing down",
                           file=sys.stderr)
                     for _, q in procs:  # kill.sh parity — SIGTERM first
@@ -281,15 +359,31 @@ def _run_once(cmd: List[str], num_processes: int, coordinator: str,
                           file=sys.stderr)
                     events.emit("teardown_kill", attempt=attempt, rank=r2,
                                 grace_s=teardown_grace)
+                    td_killed.add(r2)
                     q.kill()
                 term_at = None  # killed; the loop reaps their exits
+            if (grow_check is not None and not grew and term_at is None
+                    and procs and grow_check()):
+                # capacity re-announced while running shrunken: drain
+                # the job at a CHECKPOINT BOUNDARY (SIGTERM ⇒ the
+                # preemption path's emergency sealed checkpoint at the
+                # next step boundary ⇒ exit 75) and let the caller
+                # relaunch at the restored topology
+                grew = True
+                events.emit("grow_triggered", attempt=attempt)
+                print("elastic: capacity re-announced — draining for a "
+                      "grow-back relaunch at the next checkpoint "
+                      "boundary", file=sys.stderr)
+                for _, q in procs:
+                    q.send_signal(signal.SIGTERM)
+                term_at = time.monotonic()
             time.sleep(0.2)
     finally:
         for _, q in procs:
             q.kill()
         for f in logs:
             f.close()
-    return rc
+    return rc, first_cls, grew
 
 
 def launch_local(cmd: List[str], num_processes: int, coordinator: str,
@@ -300,7 +394,9 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
                  restart_window_s: float = 3600.0,
                  restart_backoff_s: float = 1.0,
                  max_preemptions: int = 100,
-                 teardown_grace: float = 60.0) -> int:
+                 teardown_grace: float = 60.0,
+                 elastic: bool = False, min_devices: int = 1,
+                 max_elastic: int = 16) -> int:
     """Run the job, supervising it.
 
     On any rank failing (or hanging, with ``heartbeat_timeout``), tear
@@ -319,6 +415,29 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
           supervision was actually requested (``max_restarts`` > 0 or a
           ``heartbeat_timeout``): an unsupervised launch whose operator
           SIGTERMs it must STOP, not resurrect itself 100 times.
+      device_loss (EXIT_DEVICE_LOST, 76) / host_loss (heartbeat-lost
+          kill, or an UNPROMPTED SIGKILL — the OOM-killer / the host
+          vanishing) — with ``elastic`` set, these are TOPOLOGY losses,
+          not crashes: restarting at the same size would fail the same
+          way, so the supervisor SHRINKS instead (host loss drops the
+          lost host's worth of ranks; device loss halves the local
+          device count — the finest granularity an emulated topology
+          can report), relaunches on the surviving mesh at the last
+          checkpoint, and refuses LOUDLY when the result would fall
+          below ``min_devices``.  The training command resolves its own
+          parallelization against whatever it attaches (``--plan auto``
+          re-plans; mirrored re-meshes), so the GLOBAL batch and step
+          semantics are invariant across the shrink.  Capped by
+          ``max_elastic`` (a flapping-fabric backstop), never by the
+          crash budget.  While shrunken, the supervisor probes
+          ``<log_dir>/elastic_rejoin.json`` (a healed host's agent — or
+          an operator — re-announces capacity there): once the
+          announced device count covers the full topology again, the
+          job is DRAINED at a checkpoint boundary (SIGTERM ⇒ emergency
+          sealed checkpoint ⇒ exit 75) and relaunched at full size —
+          preemption becomes a throughput dip, not an outage.  Without
+          ``elastic`` both classifications fall back to the budgeted
+          crash policy (the label still lands in the event log).
       crash (any other nonzero, incl. death by signal) — budgeted:
           ``max_restarts`` crashes per sliding ``restart_window_s``
           window (a long healthy run earns its budget back — unlike
@@ -340,20 +459,126 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
     # obs/trace.new_trace_id().
     run_trace_id = os.environ.get("DTF_TRACE_ID") or os.urandom(8).hex()
     events = SupervisorEventLog(log_dir)
-    supervising = bool(max_restarts) or heartbeat_timeout is not None
+    supervising = (bool(max_restarts) or heartbeat_timeout is not None
+                   or elastic)
+    if elastic and not devices_per_process and num_processes <= 1:
+        raise ValueError(
+            "--elastic needs a topology the supervisor can shrink: "
+            "--devices_per_process (local/virtual device count) or "
+            "--num_processes > 1")
+    # elastic topology state: the full (launch-time) topology and the
+    # current surviving one.  dpp=None means "whatever is attached" —
+    # it counts as 1 for totals so the multi-process host-loss lever
+    # still works without a device count.
+    dpp1 = lambda d: d if d else 1
+    full_procs, full_dpp = num_processes, devices_per_process
+    cur_procs, cur_dpp = num_processes, devices_per_process
+    full_total = full_procs * dpp1(full_dpp)
+    losses = 0
+    if elastic:
+        # a rejoin announce surviving a PREVIOUS job must not trigger
+        # an instant spurious grow
+        try:
+            os.unlink(os.path.join(log_dir, REJOIN_FILE))
+        except OSError:
+            pass
     attempt = 0
     preemptions = 0
     crash_times: collections.deque = collections.deque()
     while True:
-        rc = _run_once(cmd, num_processes, coordinator, log_dir,
-                       devices_per_process, stagger_s, heartbeat_timeout,
-                       attempt=attempt, startup_grace=startup_grace,
-                       events=events, teardown_grace=teardown_grace,
-                       trace_id=run_trace_id)
-        cls = classify_exit(rc)
-        if cls == "ok":
+        cur_total = cur_procs * dpp1(cur_dpp)
+        grow_check = None
+        if elastic and cur_total < full_total:
+            grow_check = (lambda need=full_total:
+                          (read_rejoin(log_dir) or 0) >= need)
+        rc, cls, grew = _run_once(
+            cmd, cur_procs, coordinator, log_dir,
+            cur_dpp, stagger_s, heartbeat_timeout,
+            attempt=attempt, startup_grace=startup_grace,
+            events=events, teardown_grace=teardown_grace,
+            trace_id=run_trace_id, grow_check=grow_check,
+            # only exported when the supervisor actually KNOWS the
+            # device total (devices_per_process set): in multi-process
+            # mode without it, cur_total counts ranks, not devices,
+            # and the runner's topology verification against it would
+            # wrongly refuse any rank attaching more than one device
+            elastic_devices=(cur_total if elastic and cur_dpp
+                             else None))
+        if grew and rc != 0:
+            # deliberately drained for growth (the expected exits are
+            # 75 after the emergency checkpoint): restore the full
+            # topology, consume the announce, relaunch outside the
+            # crash budget.  A rank that died DIRTY during the drain
+            # (anything but preempted) is recorded honestly — the
+            # relaunch still resumes from the last SEALED checkpoint,
+            # losing at most the boundary save, and the loop is
+            # bounded because each grow needs a fresh shrink, which
+            # max_elastic caps.
+            try:
+                os.unlink(os.path.join(log_dir, REJOIN_FILE))
+            except OSError:
+                pass
+            cur_procs, cur_dpp = full_procs, full_dpp
+            attempt += 1
+            events.emit("elastic_grow", restart=attempt, procs=cur_procs,
+                        devices_per_process=cur_dpp,
+                        total_devices=full_total,
+                        drain_classification=cls)
+            if cls != "preempted":
+                print(f"elastic: grow-back drain exited DIRTY "
+                      f"({cls}, rc {rc}) — the boundary checkpoint may "
+                      f"be missing; resuming from the last sealed one",
+                      file=sys.stderr)
+            print(f"elastic: growing back to {full_total} device(s) "
+                  f"({cur_procs} rank(s)) — restart {attempt}",
+                  file=sys.stderr)
+            continue
+        if cls == "ok" or rc == 0:
             events.emit("job_done", attempts=attempt)
             return 0
+        if elastic and cls in ("device_loss", "host_loss"):
+            losses += 1
+            if losses > max_elastic:
+                events.emit("give_up", code=rc, classification=cls,
+                            losses=losses, max_elastic=max_elastic)
+                print(f"giving up: {losses} topology losses exceed "
+                      f"--max_elastic {max_elastic} (flapping fabric?)",
+                      file=sys.stderr)
+                return rc
+            if cls == "host_loss" and cur_procs > 1:
+                # the lost host's ranks are gone; its devices with it
+                new_procs, new_dpp = cur_procs - 1, cur_dpp
+            elif dpp1(cur_dpp) > 1:
+                # device loss (or a single-process host emulation):
+                # halve the local device count — the finest surviving-
+                # capacity granularity an exit code can report
+                new_procs, new_dpp = cur_procs, dpp1(cur_dpp) // 2
+            else:
+                new_procs, new_dpp = cur_procs - 1, cur_dpp
+            new_total = new_procs * dpp1(new_dpp)
+            if new_procs < 1 or new_total < min_devices:
+                events.emit("give_up", code=rc, classification=cls,
+                            reason="min_devices",
+                            surviving_devices=new_total,
+                            min_devices=min_devices)
+                print(f"giving up: {cls} would shrink the job to "
+                      f"{new_total} device(s), below the --min_devices "
+                      f"floor ({min_devices}) — refusing to resume "
+                      f"that small; waiting for capacity is the "
+                      f"operator's call", file=sys.stderr)
+                return rc
+            cur_procs, cur_dpp = new_procs, new_dpp
+            attempt += 1
+            events.emit("elastic_shrink", classification=cls,
+                        restart=attempt, procs=cur_procs,
+                        devices_per_process=cur_dpp,
+                        total_devices=new_total, losses=losses,
+                        max_elastic=max_elastic)
+            print(f"elastic: {cls} — resuming smaller on {new_total} "
+                  f"device(s) ({cur_procs} rank(s)) at the last "
+                  f"checkpoint (restart {attempt}; crash budget "
+                  f"untouched)", file=sys.stderr)
+            continue
         if cls == "preempted":
             if not supervising:
                 events.emit("give_up", code=rc, classification=cls,
@@ -375,11 +600,14 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
                         backoff_s=0.0, preemptions=preemptions,
                         crashes_in_window=len(crash_times),
                         budget=max_restarts)
-            print(f"relaunching all {num_processes} ranks after "
+            print(f"relaunching all {cur_procs} ranks after "
                   f"preemption (restart {attempt}; crash budget "
                   f"untouched)", file=sys.stderr)
             continue
-        # crash: sliding-window budget + exponential backoff
+        # crash — including device/host loss WITHOUT --elastic (the
+        # honest label still landed in the event log, but the policy
+        # without an elastic mandate is the plain budgeted restart):
+        # sliding-window budget + exponential backoff
         now = time.monotonic()
         while crash_times and now - crash_times[0] > restart_window_s:
             crash_times.popleft()
@@ -394,7 +622,7 @@ def launch_local(cmd: List[str], num_processes: int, coordinator: str,
         events.emit("restart", classification=cls, restart=attempt,
                     backoff_s=backoff, crashes_in_window=len(crash_times),
                     window_s=restart_window_s, budget=max_restarts)
-        print(f"relaunching all {num_processes} ranks (crash "
+        print(f"relaunching all {cur_procs} ranks (crash "
               f"{len(crash_times)}/{max_restarts} in window; backoff "
               f"{backoff:.1f}s)", file=sys.stderr)
         if backoff > 0:
@@ -448,6 +676,9 @@ def main(argv=None) -> int:
     restart_backoff_s = 1.0
     max_preemptions = 100
     teardown_grace = 60.0
+    elastic = False
+    min_devices = 1
+    max_elastic = 16
     supervise_flags_set = False
     i = 0
     while i < len(opts):
@@ -483,6 +714,15 @@ def main(argv=None) -> int:
         elif o == "--teardown_grace":
             teardown_grace = float(opts[i + 1])
             supervise_flags_set = True; i += 2
+        elif o == "--elastic":
+            elastic = True
+            supervise_flags_set = True; i += 1
+        elif o == "--min_devices":
+            min_devices = int(opts[i + 1])
+            supervise_flags_set = True; i += 2
+        elif o == "--max_elastic":
+            max_elastic = int(opts[i + 1])
+            supervise_flags_set = True; i += 2
         else:
             raise ValueError(f"unknown launcher option {o}")
 
@@ -496,8 +736,9 @@ def main(argv=None) -> int:
             raise ValueError(
                 "--max_restarts/--heartbeat_timeout/--startup_grace/"
                 "--restart_window/--restart_backoff/--max_preemptions/"
-                "--teardown_grace supervise local fan-out; for --hosts "
-                "runs, supervise on each host")
+                "--teardown_grace/--elastic/--min_devices/--max_elastic "
+                "supervise local fan-out; for --hosts runs, supervise "
+                "on each host")
         if coordinator == "localhost:12346":
             coordinator = f"{hosts[0]}:12346"
         lines = cluster_commands(cmd, hosts, coordinator, log_dir,
@@ -530,7 +771,9 @@ def main(argv=None) -> int:
                         restart_window_s=restart_window_s,
                         restart_backoff_s=restart_backoff_s,
                         max_preemptions=max_preemptions,
-                        teardown_grace=teardown_grace)
+                        teardown_grace=teardown_grace,
+                        elastic=elastic, min_devices=min_devices,
+                        max_elastic=max_elastic)
 
 
 if __name__ == "__main__":

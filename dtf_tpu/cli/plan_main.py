@@ -62,10 +62,13 @@ _OWN_FLAGS = {
     "calibrate": (False, False),
     "calibrate_steps": (True, 8),
     "calibrate_tolerance": (True, 2.0),
-    # ZeRO-2/3 compute/comm overlap fraction the cost model credits
-    # (cost_model.DEFAULT_OVERLAP_FRAC when unset); --calibrate emits
-    # the measured run's IMPLIED fraction as plan_overlap_frac_implied
-    # — feed that back here to pin the model to this box
+    # ZeRO-2/3 compute/comm overlap fraction the cost model credits.
+    # Unset = AUTO: a prior --calibrate's measured fraction persisted
+    # in --plan_cache for this (workload, mesh), else
+    # cost_model.DEFAULT_OVERLAP_FRAC.  --calibrate emits the measured
+    # run's IMPLIED fraction as plan_overlap_frac_implied and (with
+    # --plan_cache) persists it, closing the loop without an operator;
+    # an explicit value here always wins
     "overlap_frac": (True, None),
 }
 
@@ -212,6 +215,15 @@ def _calibrate(cfg, stats, mesh, plan, steps: int, tolerance: float,
             print(f"  overlap: modeled frac "
                   f"{cost.breakdown.get('overlap_frac', 0.0):.2f}, "
                   f"measured-implied {implied:.2f}")
+            if cfg.plan_cache:
+                # close the loop: persist the measured fraction per
+                # (workload, mesh) so every later --plan auto resolve
+                # and ranking against this cache uses it instead of
+                # the model default — no operator in the loop
+                from dtf_tpu.plan.cache import store_calibration
+                store_calibration(cfg.plan_cache, stats, mesh, implied)
+                print(f"  overlap: persisted to {cfg.plan_cache} — "
+                      f"auto-applied by later rankings/resolves")
     reg.gauge("plan_predicted_peak_bytes", unit="bytes").set(
         cost.peak_bytes)
     if measured_live:
@@ -261,8 +273,21 @@ def main(argv=None) -> int:
 
     stats = stats_for_config(cfg)
     mesh = mesh_spec(cfg.plan_mesh)
-    overlap = (DEFAULT_OVERLAP_FRAC if own["overlap_frac"] is None
+    # effective overlap fraction: an explicit --overlap_frac wins;
+    # else the plan cache's persisted --calibrate measurement for this
+    # (workload, mesh) — the feedback loop closing without an operator
+    # — else the model default
+    overlap = (None if own["overlap_frac"] is None
                else float(own["overlap_frac"]))
+    if overlap is None and cfg.plan_cache:
+        from dtf_tpu.plan.cache import load_calibration
+        overlap = load_calibration(cfg.plan_cache, stats, mesh)
+        if overlap is not None:
+            print(f"plan cache: using MEASURED overlap_frac "
+                  f"{overlap:.2f} from a prior --calibrate "
+                  f"(--overlap_frac overrides)")
+    if overlap is None:
+        overlap = DEFAULT_OVERLAP_FRAC
 
     if cfg.plan and cfg.plan != "auto":
         # evaluate ONE explicit plan (still printed as a 1-row ranking)

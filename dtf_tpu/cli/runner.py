@@ -383,6 +383,13 @@ def _run(cfg: Config) -> dict:
                     step, cfg.input_num_shards)
             return {"seed": cfg.seed, "global_step": step,
                     "epoch": step // spe, "step_in_epoch": step % spe,
+                    # which mesh WROTE this step — informational for
+                    # elastic post-mortems, never validated on restore
+                    # (topology is exactly what an elastic resume may
+                    # change; the canonical layout is topology-free)
+                    "topology": {"devices": rt.num_devices,
+                                 "replicas": rt.num_replicas,
+                                 "processes": jax.process_count()},
                     "data": data}
         ckpt_cb = ckpt_mod.CheckpointCallback(
             cfg.model_dir, every_steps=cfg.checkpoint_steps,
@@ -472,6 +479,12 @@ def _run(cfg: Config) -> dict:
                     "training from scratch", cfg.model_dir)
         if not cfg.skip_checkpoint:
             callbacks.append(ckpt_cb)
+    # elastic supervision (DTF_ELASTIC_DEVICES exported by launch.py
+    # --elastic): verify the attached topology matches the
+    # supervisor's surviving-capacity accounting, and stamp the resume
+    # point + topology into the trace (no-op otherwise)
+    from dtf_tpu.train import elastic
+    elastic.note_elastic_resume(rt, resumed_step)
     if cfg.enable_tensorboard and cfg.model_dir and is_coordinator():
         from dtf_tpu.utils.tensorboard import TensorBoardCallback
         callbacks.append(TensorBoardCallback(cfg.model_dir))

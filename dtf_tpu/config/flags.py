@@ -257,6 +257,13 @@ class Config:
     # in the canonical stage-0 layout, so any stage restores into any
     # other and into serving via the bridge
     zero_stage: int = 0
+    # ZeRO-2/3 grad reduce-scatter WIRE format: fp32 (default) | bf16.
+    # bf16 halves the per-microbatch scatter volume — the collective
+    # then also sums in bf16 (the --ps_wire bf16 trade, applied to the
+    # FSDP path); the slices and the cross-microbatch accumulation
+    # stay f32 (train/zero.py scatter_leaf).  Documented loss
+    # tolerance vs the f32 wire is pinned by tests/test_zero_stages.py
+    zero_wire: str = "fp32"
     # measure the ZeRO collective cost (stages >= 2): time standalone
     # reduce-scatter/all-gather probes plus a comm-stubbed twin of the
     # compiled step, and export train_zero_*_wall_s +
@@ -502,6 +509,14 @@ class Config:
             raise ValueError(
                 "--zero_probe measures the stage-2/3 collectives; it "
                 "needs --zero_stage 2 or 3")
+        if self.zero_wire not in ("fp32", "bf16"):
+            raise ValueError(
+                f"unknown zero_wire {self.zero_wire!r}; choose fp32 "
+                f"or bf16")
+        if self.zero_wire == "bf16" and self.zero_stage_effective < 2:
+            raise ValueError(
+                "--zero_wire bf16 rides the stage-2/3 grad "
+                "reduce-scatter; it needs --zero_stage 2 or 3")
         if self.clip_grad_norm is not None:
             import math
             if (not math.isfinite(self.clip_grad_norm)

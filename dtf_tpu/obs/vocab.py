@@ -66,6 +66,9 @@ KNOWN_EVENT_KINDS = (
     "ledger_exec", "ledger_summary",
     # ZeRO compute/comm overlap probe (train/loop.py --zero_probe)
     "zero_overlap",
+    # elastic shrink/grow resume (train/elastic.py): the topology a
+    # resumed attempt actually trained on
+    "elastic_resume",
     # --profile_steps output-path marker (train/loop.py)
     "profiler_trace",
 )
@@ -78,7 +81,7 @@ KNOWN_EVENT_KINDS = (
 CHAOS_FAULT_KINDS = (
     "crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
     "reader_crash", "replica_kill", "net_partition", "slow_replica",
-    "rollout_kill",
+    "rollout_kill", "device_loss", "host_loss",
 )
 
 #: metric-name grammar: <subsystem>_<name>[_<unit-ish suffix>], where

@@ -43,7 +43,13 @@ log = logging.getLogger("dtf_tpu")
 #     peak-bytes terms + the exposed-comm overlap term (overlap_frac
 #     joins the key) — a v1 entry describes a DIFFERENT ranking
 #     function and must recompute, not serve
-CACHE_VERSION = 2
+# v3: measured-overlap calibration section — `plan_main --calibrate`
+#     persists plan_overlap_frac_implied per (workload, mesh) and
+#     auto-resolution (`--plan auto` with a cache, rankings without an
+#     explicit --overlap_frac) reads it back, so the overlap fraction
+#     an entry was ranked under may now be a measured number a v2 file
+#     cannot carry — v2 entries recompute, not serve
+CACHE_VERSION = 3
 
 
 def cache_key(stats: ModelStats, mesh: MeshSpec, global_batch: int,
@@ -93,11 +99,12 @@ def load_ranking(path: str, key: str) -> Optional[List[RankedPlan]]:
         return None
 
 
-def store_ranking(path: str, key: str, payload: dict,
-                  ranked: List[RankedPlan]) -> None:
-    """Merge one entry into the sidecar (atomic rename — two racing
-    plan resolves at worst each write a complete file).  Write failures
-    warn and continue: the ranking is already in hand."""
+def _merge_into_doc(path: str, mutate) -> None:
+    """Read-modify-write the sidecar atomically (tmp + rename — two
+    racing writers at worst each write a complete file).  A
+    version-mismatched or corrupt existing file is overwritten fresh.
+    Write failures warn and continue: the result in hand is
+    unaffected."""
     try:
         doc = {"cache_version": CACHE_VERSION, "entries": {}}
         if os.path.exists(path):
@@ -108,10 +115,7 @@ def store_ranking(path: str, key: str, payload: dict,
                     doc = existing
             except (OSError, ValueError):
                 pass                      # overwrite the corrupt file
-        doc.setdefault("entries", {})[key] = {
-            "workload": payload,
-            "ranked": [r.to_dict() for r in ranked],
-        }
+        mutate(doc)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -124,15 +128,102 @@ def store_ranking(path: str, key: str, payload: dict,
             if os.path.exists(tmp):
                 os.unlink(tmp)
     except OSError as e:
-        log.warning("plan cache %s not writable (%s) — search result "
-                    "still used, just not memoized", path, e)
+        log.warning("plan cache %s not writable (%s) — result still "
+                    "used, just not memoized", path, e)
+
+
+def store_ranking(path: str, key: str, payload: dict,
+                  ranked: List[RankedPlan]) -> None:
+    """Merge one ranking entry into the sidecar."""
+    def mutate(doc):
+        doc.setdefault("entries", {})[key] = {
+            "workload": payload,
+            "ranked": [r.to_dict() for r in ranked],
+        }
+    _merge_into_doc(path, mutate)
+
+
+# ---------------------------------------------------------------------------
+# Measured-overlap calibration (the --calibrate feedback loop).  The
+# cost model's ZeRO-2/3 exposed-comm term credits an overlap fraction;
+# `plan_main --calibrate` MEASURES the implied fraction on a live box
+# (plan_overlap_frac_implied).  Persisting it here, keyed by (workload,
+# mesh) — NOT by batch or optimizer, which don't change how well the
+# scheduler hides the wire — closes the loop without an operator:
+# every later `--plan auto` resolve and ranking against the same cache
+# uses the measured fraction instead of DEFAULT_OVERLAP_FRAC.
+# ---------------------------------------------------------------------------
+
+def calibration_key(stats: ModelStats, mesh: MeshSpec) -> Tuple[str, dict]:
+    """(sha1 hex key, human-readable payload) for one calibration
+    point."""
+    payload = {
+        "cache_version": CACHE_VERSION,
+        "model": stats.model,
+        "family": stats.family,
+        "seq_len": stats.seq_len,
+        "params": stats.params,
+        "mesh": mesh.to_dict(),
+    }
+    blob = json.dumps(payload, sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest(), payload
+
+
+def store_calibration(path: str, stats: ModelStats, mesh: MeshSpec,
+                      overlap_frac_implied: float) -> None:
+    """Persist a measured overlap fraction for (workload, mesh)."""
+    key, payload = calibration_key(stats, mesh)
+    def mutate(doc):
+        doc.setdefault("calibrations", {})[key] = {
+            "workload": payload,
+            "overlap_frac_implied": float(overlap_frac_implied),
+        }
+    _merge_into_doc(path, mutate)
+    log.info("plan cache: persisted measured overlap_frac %.2f for "
+             "(%s, %s)", overlap_frac_implied, stats.model, mesh.name)
+
+
+def load_calibration(path: str, stats: ModelStats,
+                     mesh: MeshSpec) -> Optional[float]:
+    """The persisted measured overlap fraction for (workload, mesh), or
+    None (no calibration / unreadable / out-of-range — all degrade to
+    the model default, never to an error)."""
+    if not os.path.exists(path):
+        return None
+    key, _ = calibration_key(stats, mesh)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        entry = doc.get("calibrations", {}).get(key)
+        if entry is None:
+            return None
+        val = float(entry["overlap_frac_implied"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        log.warning("plan cache %s calibration unreadable (%s: %s) — "
+                    "using the default overlap fraction", path,
+                    type(e).__name__, e)
+        return None
+    return val if 0.0 <= val <= 1.0 else None
 
 
 def cached_search(path: str, stats: ModelStats, mesh: MeshSpec,
                   global_batch: int, optimizer: str = "sgd",
-                  overlap_frac: float = DEFAULT_OVERLAP_FRAC
+                  overlap_frac: Optional[float] = None
                   ) -> Tuple[List[RankedPlan], bool]:
-    """search() through the sidecar: (ranked, was_a_hit)."""
+    """search() through the sidecar: (ranked, was_a_hit).
+
+    ``overlap_frac=None`` means AUTO: use the persisted measured
+    calibration for this (workload, mesh) when one exists — the
+    ``--calibrate`` feedback loop closing without an operator — else
+    ``DEFAULT_OVERLAP_FRAC``.  An explicit value always wins.  The
+    fraction is part of the ranking key, so a fresh calibration never
+    serves a stale ranking."""
+    if overlap_frac is None:
+        cal = load_calibration(path, stats, mesh)
+        if cal is not None:
+            log.info("plan cache: using calibrated overlap_frac %.2f "
+                     "for (%s, %s)", cal, stats.model, mesh.name)
+        overlap_frac = cal if cal is not None else DEFAULT_OVERLAP_FRAC
     key, payload = cache_key(stats, mesh, global_batch, optimizer,
                              overlap_frac=overlap_frac)
     cached = load_ranking(path, key)

@@ -178,7 +178,11 @@ def resolve_plan(cfg, mesh: Optional[MeshSpec] = None):
     if cfg.plan == "auto":
         if cfg.plan_cache:
             # memoized lattice: launcher restarts and repeated resolves
-            # skip the search; the pick + loud-failure logic is shared
+            # skip the search; the pick + loud-failure logic is shared.
+            # overlap_frac defaults to AUTO here: a prior `plan_main
+            # --calibrate` against this cache persisted the MEASURED
+            # overlap fraction for (workload, mesh), and resolution
+            # uses it without an operator in the loop (plan/cache.py)
             from dtf_tpu.plan.cache import cached_search
             from dtf_tpu.plan.search import best_from_ranked
             ranked_list, _ = cached_search(

@@ -243,6 +243,15 @@ class Trainer:
         # locally-shaped state (zero_lib.zero_leaf_spec).
         self.zero_stage = cfg.zero_stage_effective
         self.zero = self.zero_stage >= 1
+        # --zero_wire bf16: the per-microbatch grad reduce-scatter
+        # crosses the wire (and sums) in bf16, halving stage-2/3
+        # scatter volume; the returned slices and the cross-microbatch
+        # accumulation carry stay f32 (the --ps_wire bf16 trade,
+        # applied to the FSDP path — documented loss tolerance pinned
+        # by tests/test_zero_stages.py)
+        self.zero_wire = (jnp.bfloat16
+                          if getattr(cfg, "zero_wire", "fp32") == "bf16"
+                          else jnp.float32)
 
         if self.param_spec_fn is None and not self.zero:
             self._build_steps()
@@ -300,6 +309,20 @@ class Trainer:
             pspecs = (self.param_spec_fn(params)
                       if self.param_spec_fn is not None
                       else jax.tree_util.tree_map(lambda _: P(), params))
+            # elastic shrink/grow resumes land here with an ARBITRARY
+            # surviving mesh: leaves whose model spec pins a tensor dim
+            # to a mesh axis (experts over 'data', TP/PP over 'model')
+            # must refuse a non-dividing topology loudly — the ZeRO
+            # flat-slice layout itself reshards onto any nd by
+            # construction (pad_flat zero-pads to the new grid)
+            from dtf_tpu.train import elastic as elastic_lib
+            problems = elastic_lib.check_reshardable(
+                pspecs, params, mesh_shape)
+            if problems:
+                raise ValueError(
+                    "model cannot shard onto this mesh (an elastic "
+                    "resume must refuse, not garble): "
+                    + "; ".join(problems))
             opt_pspecs = jax.tree_util.tree_map(zero_lib.zero_leaf_spec,
                                                 pspecs, is_leaf=is_p)
 
@@ -592,6 +615,7 @@ class Trainer:
         mesh_shape = dict(mesh.shape)
         nd = mesh_shape[DATA_AXIS]
         zero_stage = self.zero_stage
+        zero_wire = self.zero_wire
 
         def reduce_grads(grads):
             if param_specs is None:
@@ -719,7 +743,7 @@ class Trainer:
                 return jax.tree_util.tree_map(
                     lambda spec, g: zero_lib.scatter_leaf(
                         spec, g, nd, reduce_axes, mesh_shape, comm_off,
-                        idx),
+                        idx, wire=zero_wire),
                     zspecs, grads, is_leaf=is_p)
 
             g_slices_acc = None
@@ -1005,10 +1029,12 @@ class Trainer:
 
         def scatter_local(p):
             idx = lax.axis_index(DATA_AXIS)
+            # same wire dtype as the live step: the probe must price
+            # the collectives the run actually emits (--zero_wire)
             return zero_lib.tree_map_specs(
                 lambda spec, g: zero_lib.scatter_leaf(
                     spec, g.astype(jnp.float32), nd, reduce_axes,
-                    mesh_shape, False, idx),
+                    mesh_shape, False, idx, wire=self.zero_wire),
                 pspecs, p)
 
         def gather_local(s):

@@ -124,12 +124,22 @@ def gather_leaf(spec, s, shape, dtype, nd: int, comm_off: bool = False):
 
 
 def scatter_leaf(spec, g, nd: int, reduce_axes, mesh_shape,
-                 comm_off: bool = False, idx=None):
+                 comm_off: bool = False, idx=None, wire=jnp.float32):
     """Reduce-scatter one local grad leaf into this shard's f32 slice
     (mean over the batch-splitting axes).  Leaves sharded over 'data'
     (experts) keep their local shape: reverse-mode all_to_all already
     summed their true grads, so they divide to the global-mean
-    convention instead of psum-ing."""
+    convention instead of psum-ing.
+
+    ``wire`` is the reduce-scatter WIRE dtype (``--zero_wire``): bf16
+    halves the stage-2/3 scatter volume — the collective then also
+    SUMS in bf16, which is the documented trade (the same one
+    ``--ps_wire bf16`` ships on the async-PS path).  The returned
+    slice is always f32, so the cross-microbatch accumulation carry
+    (``slice_zeros``) and the optimizer update math keep full
+    precision whatever crosses the wire.  Expert leaves are exempt:
+    their true grads were already summed exactly by the all_to_all
+    transpose — there is no wire volume left to trade."""
     sharded = spec_axes(spec) if not isinstance(spec, Replicated) else set()
     if DATA_AXIS in sharded:
         axes = tuple(a for a in reduce_axes if a not in sharded)
@@ -140,12 +150,13 @@ def scatter_leaf(spec, g, nd: int, reduce_axes, mesh_shape,
             if a in sharded:
                 denom *= mesh_shape[a]
         return (g / denom).astype(jnp.float32)
-    flat = pad_flat(g.astype(jnp.float32), nd)
+    flat = pad_flat(g.astype(wire), nd)
     if comm_off:
         k = flat.shape[0] // nd
-        return lax.dynamic_slice_in_dim(flat, idx * k, k) / nd
+        return (lax.dynamic_slice_in_dim(flat, idx * k, k)
+                .astype(jnp.float32) / nd)
     s = lax.psum_scatter(flat, DATA_AXIS, scatter_dimension=0,
-                         tiled=True) / nd
+                         tiled=True).astype(jnp.float32) / nd
     return lax.pmean(s, SEQ_AXIS)
 
 

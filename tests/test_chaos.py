@@ -45,6 +45,11 @@ def test_parse_spec_grammar():
                      ("ps_drop", None, 50), ("heartbeat_stall", None, 60),
                      ("ckpt_truncate", None, None)]
     assert str(specs[1]) == "sigterm@rank1:step:80"
+    # the elastic topology-loss kinds ride the same step point
+    specs = chaos.parse_spec("device_loss@step:4,host_loss@rank2:step:6")
+    assert [(s.kind, s.rank, s.value) for s in specs] == [
+        ("device_loss", None, 4), ("host_loss", 2, 6)]
+    assert str(specs[1]) == "host_loss@rank2:step:6"
 
 
 def test_parse_spec_distributed_kinds():

@@ -894,3 +894,88 @@ def test_bench_plan_smoke(tmp_path):
     assert rc == 0
     plan = load_plan_file(str(out))
     assert plan.num_devices == PRESETS["cpu"].num_devices
+
+
+def test_plan_cache_calibration_feedback_loop(tmp_path):
+    """The --calibrate feedback loop (ROADMAP FSDP follow-on #2): a
+    measured plan_overlap_frac_implied persisted per (workload, mesh)
+    is auto-applied by later cached searches — the ranking key carries
+    the calibrated fraction, so a fresh calibration re-ranks instead
+    of serving the default-fraction entry — while an EXPLICIT
+    --overlap_frac always wins, and unknown workloads fall back to the
+    default."""
+    from dtf_tpu.plan.cache import (cached_search, load_calibration,
+                                    store_calibration)
+    from dtf_tpu.plan.compile import stats_for_config
+    from dtf_tpu.plan.cost_model import DEFAULT_OVERLAP_FRAC
+    from dtf_tpu.plan.mesh_spec import mesh_spec
+    from dtf_tpu.plan.search import search
+
+    cfg = Config(model="transformer_small", dataset="lm", batch_size=8,
+                 seq_len=64)
+    stats = stats_for_config(cfg)
+    mesh = mesh_spec("cpu")
+    path = str(tmp_path / "plan_cache.json")
+
+    # no calibration yet: auto == default fraction
+    assert load_calibration(path, stats, mesh) is None
+    auto, hit = cached_search(path, stats, mesh, 8)
+    assert not hit
+    default_ranked = search(stats, mesh, 8,
+                            overlap_frac=DEFAULT_OVERLAP_FRAC)
+    assert ([r.to_dict() for r in auto]
+            == [r.to_dict() for r in default_ranked])
+
+    # persist a measured fraction; auto now uses it (a MISS — the
+    # fraction is part of the ranking key) and matches a fresh search
+    # at that fraction
+    store_calibration(path, stats, mesh, 0.9)
+    assert load_calibration(path, stats, mesh) == pytest.approx(0.9)
+    cal, hit2 = cached_search(path, stats, mesh, 8)
+    assert not hit2
+    cal_ranked = search(stats, mesh, 8, overlap_frac=0.9)
+    assert ([r.to_dict() for r in cal]
+            == [r.to_dict() for r in cal_ranked])
+    _, hit3 = cached_search(path, stats, mesh, 8)
+    assert hit3                       # memoized under the new fraction
+
+    # explicit fraction overrides the calibration
+    exp, _ = cached_search(path, stats, mesh, 8, overlap_frac=0.1)
+    exp_ranked = search(stats, mesh, 8, overlap_frac=0.1)
+    assert ([r.to_dict() for r in exp]
+            == [r.to_dict() for r in exp_ranked])
+
+    # a different mesh is a different calibration point
+    assert load_calibration(path, stats, mesh_spec("4x4")) is None
+    # out-of-range persisted values degrade to the default, not error
+    store_calibration(path, stats, mesh, 7.5)
+    assert load_calibration(path, stats, mesh) is None
+
+
+@pytest.mark.slow
+def test_plan_main_calibrate_persists_overlap_to_cache(tmp_path):
+    """`plan_main --calibrate` with --plan_cache closes the loop end to
+    end: the measured implied fraction lands in the cache file and the
+    next ranking announces it is using the MEASURED value."""
+    cache_path = tmp_path / "plan_cache.json"
+    r = _plan_main("--devices", "2", "--model", "transformer_small",
+                   "--dataset", "lm", "--use_synthetic_data",
+                   "--seq_len", "64", "--batch_size", "8",
+                   "--optimizer", "adamw", "--zero_stage", "2",
+                   "--calibrate", "--calibrate_steps", "4",
+                   "--calibrate_tolerance", "1e9", "--top", "0",
+                   "--plan_cache", str(cache_path), one_device=True)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "persisted to" in r.stdout
+    doc = json.loads(cache_path.read_text())
+    (entry,) = doc["calibrations"].values()
+    assert 0.0 <= entry["overlap_frac_implied"] <= 1.0
+    assert entry["workload"]["model"] == "transformer_small"
+    # a later ranking against the same cache announces the measurement
+    r2 = _plan_main("--devices", "2", "--model", "transformer_small",
+                    "--dataset", "lm", "--use_synthetic_data",
+                    "--seq_len", "64", "--batch_size", "8",
+                    "--optimizer", "adamw", "--top", "1",
+                    "--plan_cache", str(cache_path), one_device=True)
+    assert r2.returncode == 0, r2.stderr
+    assert "MEASURED overlap_frac" in r2.stdout

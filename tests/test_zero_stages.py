@@ -249,3 +249,56 @@ def test_zero_smoke_tool():
                         "--fast"], capture_output=True, text=True,
                        timeout=1500)
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+# ---------------------------------------------------------------------------
+# --zero_wire bf16: the grad reduce-scatter wire trade
+# ---------------------------------------------------------------------------
+
+def test_zero_wire_validation():
+    with pytest.raises(ValueError, match="zero_wire"):
+        Config(zero_wire="fp8")
+    with pytest.raises(ValueError, match="zero_wire"):
+        Config(zero_wire="bf16")              # needs stage >= 2
+    with pytest.raises(ValueError, match="zero_wire"):
+        Config(zero_wire="bf16", optimizer_sharding=True)  # stage 1
+    assert Config(zero_wire="bf16", zero_stage=2).zero_wire == "bf16"
+    assert Config(zero_stage=3).zero_wire == "fp32"
+
+
+# documented loss tolerance of the bf16 scatter wire vs the f32 wire:
+# the collective SUMS in bf16 (that is the halved-volume trade), so
+# per-step losses agree to bf16 rounding of the gradient signal —
+# orders above float-ulp, orders below any training signal
+ZERO_WIRE_LOSS_RTOL = 5e-2
+
+
+def test_zero_wire_bf16_tracks_f32_within_tolerance(eight_devices):
+    """--zero_wire bf16 halves the stage-2/3 scatter volume by casting
+    the padded flat grads to bf16 BEFORE psum_scatter (the slices and
+    the cross-microbatch accumulation stay f32).  The trajectories must
+    agree within the documented tolerance — and the wire dtype must
+    actually reach the scatter (the trainer records it)."""
+    def losses(wire):
+        cfg = _cfg("", 2, 2, checkpoint_steps=0,
+                   skip_checkpoint=True).replace(zero_wire=wire)
+        rt = initialize(cfg)
+        model, l2 = build_model("resnet20")
+        trainer = Trainer(cfg, rt, model, l2, TINY,
+                          schedule=lambda s: 0.1)
+        import jax.numpy as jnp
+        assert trainer.zero_wire == (jnp.bfloat16 if wire == "bf16"
+                                     else jnp.float32)
+        rng = np.random.default_rng(3)
+        images = rng.normal(120, 50, (8, 8, 8, 3)).astype(np.float32)
+        labels = rng.integers(0, 10, (8,)).astype(np.int32)
+        state = trainer.init_state(jax.random.key(0), (images, labels))
+        batch = rt.shard_batch((images, labels))
+        out = []
+        for _ in range(2):
+            state, m = trainer.train_step(state, *batch)
+            out.append(float(jax.device_get(m["loss"])))
+        return out
+    f32 = losses("fp32")
+    bf16 = losses("bf16")
+    np.testing.assert_allclose(bf16, f32, rtol=ZERO_WIRE_LOSS_RTOL)

@@ -110,9 +110,20 @@
 #      plan_main --calibrate holds the 2x contract for zero ∈ {2,3};
 #      and the fresh BENCH_zero artifact gates against the committed
 #      history via tools/bench_gate.py.
+#  15. tools/elastic_smoke.py — the elastic-training contract
+#      (train/elastic.py + the launch.py --elastic supervisor): a run
+#      losing a host mid-training (host_loss chaos — an unprompted
+#      SIGKILL) under --elastic resumes on HALF the devices at the
+#      sealed checkpoint, with the shrunken window's per-step loss
+#      trajectory BIT-IDENTICAL to an oracle launched fresh on N/2
+#      from the same checkpoint; when capacity re-announces the
+#      supervisor drains at a checkpoint boundary and grows the job
+#      back to N; device_loss (exit 76) classifies + reshards too; and
+#      `trace_main --check --allow injected_fault --allow
+#      host_loss/device_loss` is green.
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-14 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-15 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -120,18 +131,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/14]: tier-1 test suite =="
+    echo "== ci_check [1/15]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/14]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/15]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/14]: marker audit (test-budget contract) =="
+echo "== ci_check [2/15]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/14]: traced smoke run =="
+echo "== ci_check [3/15]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -139,13 +150,13 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/14]: anomaly cleanliness =="
+echo "== ci_check [4/15]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
 
-echo "== ci_check [5/14]: chaos smoke (kill -> resume -> exactness) =="
+echo "== ci_check [5/15]: chaos smoke (kill -> resume -> exactness) =="
 python tools/chaos_smoke.py
 
-echo "== ci_check [6/14]: parallelism planner (check + calibration) =="
+echo "== ci_check [6/15]: parallelism planner (check + calibration) =="
 python bench_plan.py --out "$TRACE_DIR/PLAN_4x4.json" >/dev/null
 python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
     --dataset lm --use_synthetic_data --seq_len 64 --batch_size 8 \
@@ -159,30 +170,33 @@ python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
     --benchmark_log_dir "$TRACE_DIR/plan_bench"
 grep -q plan_step_time_ratio "$TRACE_DIR/plan_bench/metric.log"
 
-echo "== ci_check [7/14]: data-service smoke (sharded determinism + imagenet resume exactness) =="
+echo "== ci_check [7/15]: data-service smoke (sharded determinism + imagenet resume exactness) =="
 python tools/data_service_smoke.py
 
-echo "== ci_check [8/14]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
+echo "== ci_check [8/15]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
 python tools/serve_smoke.py
 
-echo "== ci_check [9/14]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
+echo "== ci_check [9/15]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
 python tools/router_smoke.py
 
-echo "== ci_check [10/14]: perf-regression gate (committed history passes, injected regression fails) =="
+echo "== ci_check [10/15]: perf-regression gate (committed history passes, injected regression fails) =="
 python tools/bench_gate.py --smoke
 
-echo "== ci_check [11/14]: capacity-simulator smoke (record -> replay -> calibrate) =="
+echo "== ci_check [11/15]: capacity-simulator smoke (record -> replay -> calibrate) =="
 python -m dtf_tpu.cli.plan_serve_main --calibrate --calibrate_tolerance 2.0 \
     --benchmark_log_dir "$TRACE_DIR/serve_plan_bench"
 grep -q plan_serve_tokens_ratio "$TRACE_DIR/serve_plan_bench/metric.log"
 
-echo "== ci_check [12/14]: rollout smoke (zero-downtime rollout: canary gate, rollback, rollout chaos) =="
+echo "== ci_check [12/15]: rollout smoke (zero-downtime rollout: canary gate, rollback, rollout chaos) =="
 python tools/rollout_smoke.py
 
-echo "== ci_check [13/14]: dtflint (static analysis: lock discipline, determinism, vocab closure, flag wiring) =="
+echo "== ci_check [13/15]: dtflint (static analysis: lock discipline, determinism, vocab closure, flag wiring) =="
 python -m tools.dtflint
 
-echo "== ci_check [14/14]: zero smoke (ZeRO-2/3 ≡ replicated, infeasible-replicated config trains, measured overlap, 2x calibration) =="
+echo "== ci_check [14/15]: zero smoke (ZeRO-2/3 ≡ replicated, infeasible-replicated config trains, measured overlap, 2x calibration) =="
 python tools/zero_smoke.py
+
+echo "== ci_check [15/15]: elastic smoke (host/device loss -> shrink resume oracle-exact -> grow back) =="
+python tools/elastic_smoke.py
 
 echo "ci_check: OK"

@@ -45,6 +45,8 @@ CHAOS_PROBES = {
     "net_partition": "net_partition",
     "slow_replica": "slow_replica",
     "rollout_kill": "rollout_kill",
+    "device_loss": "step",
+    "host_loss": "step",
 }
 
 _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
