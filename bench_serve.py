@@ -39,7 +39,14 @@ Prints ONE JSON line per metric, bench.py contract ({"metric", "value",
          traffic, measured by the replicas' own PrefixRegistry hit
          counters (bar: affinity hits > random hits);
        · KILL UNDER LOAD: SIGKILL a replica mid-burst (bar: zero lost
-         requests, ≥ 1 failover, every request completes).
+         requests, ≥ 1 failover, every request completes);
+       · DISAGGREGATED vs COLOCATED at equal chips: bursty long-prompt
+         traffic against a 1p:1d pool split (cold prompts on the
+         prefill pool, chains migrating their KV pages over the wire,
+         repeats re-homed to the decode pool) vs the same 2 replicas
+         colocated (bar: the decode pool's decode-gap p99 STRICTLY
+         below colocated — the split must buy the head-of-line tail
+         it exists for).
 
 --out writes every metric line into ONE BenchmarkMetric JSON artifact
 (BENCH_serve_rNN.json shape) so the serving perf trajectory is tracked
@@ -325,7 +332,8 @@ ROUTER_REPLICA_FLAGS = [
 
 
 def router_tier(workdir, n, *, placement="affinity", admission=128,
-                deadline_s=120.0, inflight=4, replica_flags=()):
+                deadline_s=120.0, inflight=4, replica_flags=(),
+                prefill_replicas=0, health_timeout=5.0):
     # inflight defaults to the replica SLOT count: bursts queue at the
     # ROUTER and trickle into replicas at their concurrency, so a
     # healthy-tier scenario never trips replica-level sheds.  The
@@ -338,9 +346,11 @@ def router_tier(workdir, n, *, placement="affinity", admission=128,
            *replica_flags]
     router = Router(n, rdv, spawn=replica_spawner(cmd, rdv),
                     page_size=16, probe_interval_s=0.25,
-                    health_timeout_s=5.0, deadline_s=deadline_s,
+                    health_timeout_s=health_timeout, deadline_s=deadline_s,
                     admission_limit=admission, replica_inflight=inflight,
-                    placement=placement, seed=3)
+                    placement=placement, seed=3,
+                    prefill_replicas=prefill_replicas,
+                    migrate_timeout_s=60.0)
     router.start(wait_s=600)
     return router
 
@@ -391,13 +401,16 @@ def router_scaling_and_kill(tmpdir, replicas, requests):
                         f"({lost1}+{lostN}) on a healthy tier")
 
         # kill under load: SIGKILL a replica mid-burst — zero lost,
-        # >= 1 failover, every request completes
+        # >= 1 failover, every request completes.  64-token budgets +
+        # an early kill: the burst must still be DECODING when the
+        # kill lands (at 32 tokens a ~1k tok/s box drains the whole
+        # burst in ~0.4s and the kill strands nothing — a vacuous bar)
         from dtf_tpu.serve import Backpressure, DeadlineExceeded
         rng = np.random.default_rng(21)
         handles = [rN.submit(
             rng.integers(0, 256, (12,)).astype(np.int32),
-            max_new_tokens=32) for _ in range(requests)]
-        time.sleep(0.4)                 # burst in flight on both
+            max_new_tokens=64) for _ in range(requests)]
+        time.sleep(0.2)                 # burst in flight on both
         rN.kill_replica(0)
         lost = 0
         for h in handles:
@@ -486,20 +499,30 @@ def router_affinity_bar(tmpdir, replicas, requests_per_group=8):
                              replicas, placement=arm)
         try:
             rng = np.random.default_rng(31)
+            # MORE groups than replicas: with groups == replicas both
+            # arms converge once every replica has registered every
+            # prefix (first-touch misses are all either arm pays, and
+            # 2 groups over 2 replicas can tie).  4 groups keep the
+            # structural gap — random pays a first-touch miss per
+            # (group, replica) pair, affinity one per group
             groups = [rng.integers(0, 256, (4 * 16,)).astype(np.int32)
-                      for _ in range(2)]
+                      for _ in range(4)]
             # one warmer per group (registers the prefix somewhere),
-            # then the measured burst
+            # then the measured traffic in WAVES of one request per
+            # group: a 32-deep burst spills past the per-replica
+            # inflight cap and the spill misses land on BOTH arms as
+            # noise — waves keep every affinity home eligible, so the
+            # arms differ only by placement (the thing being measured)
             for g in groups:
                 router.generate(g, max_new_tokens=2)
-            handles = []
-            for i in range(requests_per_group * len(groups)):
-                tail = rng.integers(0, 256, (5,)).astype(np.int32)
-                handles.append(router.submit(
-                    np.concatenate([groups[i % len(groups)], tail]),
-                    max_new_tokens=8))
-            for h in handles:
-                h.result(timeout=router.deadline_s + 30)
+            for _ in range(requests_per_group):
+                wave = []
+                for g in groups:
+                    tail = rng.integers(0, 256, (5,)).astype(np.int32)
+                    wave.append(router.submit(
+                        np.concatenate([g, tail]), max_new_tokens=8))
+                for h in wave:
+                    h.result(timeout=router.deadline_s + 30)
             total = 0
             for rid in range(replicas):
                 stats = router.replica_stats(rid, timeout=10)
@@ -515,6 +538,138 @@ def router_affinity_bar(tmpdir, replicas, requests_per_group=8):
         bars.append(
             f"prefix-affine routing hit {hits['affinity']} registry "
             f"pages vs random's {hits['random']} — no measured win")
+    return bars
+
+
+DISAGG_PAGE = 16               # router/replica page size (migration unit)
+DISAGG_GROUP_PAGES = 4         # shared system prompts: 4 FULL pages each
+
+
+def router_disagg_arm(workdir, *, prefill_replicas, rounds=6):
+    """One arm of the bursty long-prompt comparison at EQUAL chips
+    (2 replicas): colocated (``prefill_replicas=0``) or a 1p:1d split.
+
+    Seed phase registers two multi-page shared chains (and, in the
+    split arm, waits for their KV pages to MIGRATE to the decode pool),
+    then every decode-gap distribution is reset so compile stalls don't
+    pollute the measurement.  The measured phase is ``rounds`` bursts
+    of decode-heavy repeats (shared prefix + tail, 32-token budget)
+    with two COLD ~500-token prompts dropped mid-decode each round —
+    the head-of-line traffic disaggregation exists to absorb.
+
+    Returns ``(p99, per_replica, migrated, lost)`` where ``p99`` is
+    the decode-gap p99 experienced by the repeat traffic: max over the
+    replicas that SERVE it — all replicas when colocated, only the
+    decode pool when split (the prefill pool's gaps belong to the
+    prefill-bound cold prompts by construction; a bounded tail on the
+    decode pool is the number the split buys)."""
+    from dtf_tpu.serve import Backpressure, DeadlineExceeded
+    # seq cap raised to 512 for THIS scenario (last --flag wins): the
+    # head-of-line effect needs prompts whose chunked prefill visibly
+    # outweighs a decode step — at the tier default of 128 tokens the
+    # whole prefill costs about one step and both arms measure noise
+    router = router_tier(workdir, 2, prefill_replicas=prefill_replicas,
+                         health_timeout=15.0, deadline_s=180.0,
+                         inflight=8,
+                         replica_flags=("--serve_max_seq_len", "512"))
+    try:
+        rng = np.random.default_rng(41)
+        prefix_len = DISAGG_GROUP_PAGES * DISAGG_PAGE
+        long_len = 500             # ~31 pages of cold prefill per burst
+        groups = [rng.integers(0, 256, (prefix_len,)).astype(np.int32)
+                  for _ in range(2)]
+        # seed + warm: register the shared chains and compile every
+        # shape the measured burst hits (repeat tails, the cold long
+        # prompt, decode steps)
+        warm = [router.submit(np.concatenate(
+            [g, rng.integers(0, 256, (4,)).astype(np.int32)]),
+            max_new_tokens=8) for g in groups]
+        warm.append(router.submit(
+            rng.integers(0, 256, (long_len,)).astype(np.int32),
+            max_new_tokens=4))
+        for h in warm:
+            h.result(timeout=router.deadline_s + 30)
+        migrated = 0
+        if prefill_replicas:
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                ms = router.migration_stats()
+                if ms["migrated"] >= len(groups) and not ms["pending"]:
+                    break
+                time.sleep(0.25)
+            ms = router.migration_stats()
+            if ms["migrated"] < len(groups) or ms["failed"]:
+                raise SystemExit(
+                    f"disagg bench: seed chains never migrated ({ms}) "
+                    f"— the split arm cannot measure re-homed decode")
+            migrated = ms["migrated"]
+        for rid in range(2):
+            if not router.reset_replica_measurement(rid):
+                raise SystemExit(f"disagg bench: reset_measurement to "
+                                 f"replica {rid} failed")
+        lost = 0
+        for r in range(rounds):
+            handles = []
+            for i in range(4):
+                tail = rng.integers(0, 256, (3 + i,)).astype(np.int32)
+                handles.append(router.submit(
+                    np.concatenate([groups[i % 2], tail]),
+                    max_new_tokens=32))
+            time.sleep(0.15)   # repeats decoding when the longs land
+            for _ in range(2):
+                handles.append(router.submit(
+                    rng.integers(0, 256, (long_len,)).astype(np.int32),
+                    max_new_tokens=4))
+                time.sleep(0.1)
+            for h in handles:
+                try:
+                    h.result(timeout=router.deadline_s + 30)
+                except (Backpressure, DeadlineExceeded):
+                    lost += 1
+        per_replica = {}
+        for rid in range(2):
+            stats = router.replica_stats(rid, timeout=10) or {}
+            per_replica[rid] = {
+                "p99": float(stats.get("serve_decode_gap_p99", 0.0)),
+                "samples": int(stats.get("serve_decode_gap_count", 0))}
+        decode_pool = [r for r in range(2) if r >= prefill_replicas]
+        p99 = max(per_replica[r]["p99"] for r in decode_pool)
+        if not any(per_replica[r]["samples"] for r in decode_pool):
+            raise SystemExit(
+                f"disagg bench: no decode-gap samples on the measured "
+                f"pool ({per_replica}) — a 0.0 p99 would pass the bar "
+                f"vacuously")
+        return p99, per_replica, migrated, lost
+    finally:
+        router.stop(drain=True)
+
+
+def router_disagg_bar(tmpdir, rounds=6):
+    """Bursty long-prompt traffic, disaggregated vs colocated at equal
+    chips.  Bar: the split's decode-pool gap p99 STRICTLY below the
+    colocated p99 — migration must buy the tail it exists for."""
+    bars = []
+    colo_p99, colo_pr, _, lost_c = router_disagg_arm(
+        os.path.join(tmpdir, "disagg_colo"), prefill_replicas=0,
+        rounds=rounds)
+    split_p99, split_pr, migrated, lost_s = router_disagg_arm(
+        os.path.join(tmpdir, "disagg_split"), prefill_replicas=1,
+        rounds=rounds)
+    _jline("router_disagg_decode_gap_p99", split_p99, "s",
+           model=ROUTER_MODEL, colocated_p99=round(colo_p99, 5),
+           chains_migrated=migrated,
+           split_per_replica=split_pr, colocated_per_replica=colo_pr)
+    _jline("router_disagg_p99_ratio",
+           (colo_p99 / split_p99) if split_p99 > 0 else 0.0, "x",
+           split_beats_colocated=bool(split_p99 < colo_p99))
+    if lost_c or lost_s:
+        bars.append(f"disagg comparison lost requests (colocated "
+                    f"{lost_c}, split {lost_s}) on healthy tiers")
+    if split_p99 >= colo_p99:
+        bars.append(
+            f"disaggregation bar failed: decode-pool gap p99 "
+            f"{split_p99:.4f}s is not below colocated {colo_p99:.4f}s "
+            f"at equal chips — the pool split bought nothing")
     return bars
 
 
@@ -713,6 +868,7 @@ def main():
                 tier_dir, args.router_replicas, requests=12)
             failed += router_overload_bar(tier_dir, args.router_replicas)
             failed += router_affinity_bar(tier_dir, args.router_replicas)
+            failed += router_disagg_bar(tier_dir)
             clean = True
         finally:
             if clean and not failed:

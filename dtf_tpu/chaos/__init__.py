@@ -95,6 +95,12 @@ Kinds and their firing semantics:
                           measured step) — the straggler signature the
                           router's deadline + least-loaded placement
                           must absorb.
+  page_fetch_stall@replicaK:S  replica K's KV-page migration CLIENT
+                          stalls S seconds before each page_fetch
+                          window request (latched) — the slow-fabric
+                          signature the disaggregated router's
+                          migration timeout + local-prefill fallback
+                          must absorb without losing a request.
   rollout_kill@phase:P    the rollout controller (serve/rollout.py)
                           SIGKILLs a replica as the rollout works in
                           phase P ∈ {canary, rolling} (one-shot; an
@@ -134,7 +140,7 @@ EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
 
 KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
          "reader_crash", "replica_kill", "net_partition", "slow_replica",
-         "rollout_kill", "device_loss", "host_loss")
+         "rollout_kill", "device_loss", "host_loss", "page_fetch_stall")
 _POINTS = {
     "crash": "step",
     "sigterm": "step",
@@ -148,13 +154,16 @@ _POINTS = {
     "net_partition": "ticks",
     "slow_replica": "factor",
     "rollout_kill": "phase",
+    "page_fetch_stall": "seconds",
 }
 # rollout_kill's point value is a PHASE NAME, not a number
 ROLLOUT_PHASES = ("canary", "rolling")
 # distributed kinds whose point accepts the bare-value shorthand
 # (net_partition@replica1:6) and which require/allow a replica target
-_REPLICA_REQUIRED = ("net_partition", "slow_replica")
-_BARE_POINT = ("net_partition", "slow_replica")
+_REPLICA_REQUIRED = ("net_partition", "slow_replica", "page_fetch_stall")
+_BARE_POINT = ("net_partition", "slow_replica", "page_fetch_stall")
+# kinds whose point value is a float (everything else is an int)
+_FLOAT_POINT = ("slow_replica", "page_fetch_stall")
 
 _injector: Optional["Injector"] = None
 _lock = threading.Lock()
@@ -185,7 +194,7 @@ class FaultSpec:
         elif self.value is None:
             p = "latest"
         else:
-            v = (self.value if self.kind == "slow_replica"
+            v = (self.value if self.kind in _FLOAT_POINT
                  else int(self.value))
             p = f"{self.point}:{v}"
         return f"{self.kind}@{sel}{p}"
@@ -251,13 +260,17 @@ def parse_spec(text: str) -> List[FaultSpec]:
                     if kind in _BARE_POINT else f"'{want}:<int>'")
             raise ValueError(f"fault spec {tok!r}: {kind} takes {hint}")
         try:
-            value = (float(val) if kind == "slow_replica" else int(val))
+            value = (float(val) if kind in _FLOAT_POINT else int(val))
         except ValueError:
             raise ValueError(f"fault spec {tok!r}: {val!r} is not a number")
         if kind == "slow_replica":
             if value <= 1.0:
                 raise ValueError(
                     f"fault spec {tok!r}: slow-down factor must be > 1")
+        elif kind == "page_fetch_stall":
+            if value <= 0.0:
+                raise ValueError(
+                    f"fault spec {tok!r}: stall needs > 0 seconds")
         elif kind == "net_partition":
             if value < 1:
                 raise ValueError(
@@ -454,6 +467,22 @@ class Injector:
                 return float(spec.value)
         return 0.0
 
+    def page_fetch_stall(self) -> float:
+        """Migration-client-side, latched: seconds to stall before each
+        ``page_fetch`` window request when a stall fault targets THIS
+        process (replica id == rank), or 0.0.  A congested fabric does
+        not heal between windows, so the stall stays on once armed."""
+        with self._mu:
+            for spec in self.specs:
+                if spec.kind != "page_fetch_stall":
+                    continue
+                if spec.replica is not None and spec.replica != self.rank:
+                    continue
+                if not spec.fired:
+                    self._record(spec, seconds=float(spec.value))
+                return float(spec.value)
+        return 0.0
+
 
 # ---------------------------------------------------------------------------
 # Module-level API (what instrumented code calls) — every probe is a
@@ -564,6 +593,13 @@ def slow_replica() -> float:
     if inj is None:
         return 0.0
     return inj.slow_replica()
+
+
+def page_fetch_stall() -> float:
+    inj = _injector
+    if inj is None:
+        return 0.0
+    return inj.page_fetch_stall()
 
 
 if sys.platform == "win32":  # pragma: no cover - posix repo, belt+braces

@@ -113,11 +113,31 @@ def _build_parser() -> argparse.ArgumentParser:
                          "replicas splits by $/Mtoken AT the SLO "
                          "(fleet rate = chips × this, throughput from "
                          "the simulator); 0 = off")
+    ap.add_argument("--pool_split", action="store_true",
+                    help="with --chips: rank prefill:decode replica "
+                         "splits against colocated — the decode pool "
+                         "pays KV-page migration over the wire "
+                         "instead of prefill (see --migrate_*)")
+    ap.add_argument("--migrate_page_bytes", type=int, default=1 << 20,
+                    help="wire bytes per migrated KV page")
+    ap.add_argument("--migrate_wire_gbps", type=float, default=10.0,
+                    help="fabric bandwidth for KV-page migration, "
+                         "decimal Gbit/s")
+    ap.add_argument("--migrate_latency_ms", type=float, default=2.0,
+                    help="per-window round-trip latency of the "
+                         "page_fetch migration protocol, ms")
     # calibration
     ap.add_argument("--calibrate", action="store_true",
                     help="record a live traced engine run, replay it, "
                          "compare predicted vs measured (nonzero exit "
                          "outside the tolerance)")
+    ap.add_argument("--measure_tp_comm", action="store_true",
+                    help="measure tp_comm_frac from two live traced "
+                         "runs (tp=1 vs tp=2 over virtual host "
+                         "devices) instead of trusting the "
+                         "--tp_comm_frac default; exports the "
+                         "plan_serve_tp_comm_frac gauge and feeds the "
+                         "measured value to every what-if in this run")
     ap.add_argument("--calibrate_tolerance", type=float, default=2.0)
     ap.add_argument("--calibrate_requests", type=int, default=12)
     ap.add_argument("--calibrate_budget", type=int, default=24,
@@ -230,6 +250,39 @@ def _whatifs(args, workload, profile, base, artifact) -> None:
             "slo_p99_s": args.slo_p99,
             "ranked": [r.to_dict() for r in rows]}
 
+    if args.pool_split:
+        if not args.chips > 0:
+            raise SystemExit("--pool_split needs --chips (the budget "
+                             "the prefill:decode split carves up)")
+        best, rows = sm.pool_split(
+            workload, profile, base, args.chips,
+            page_bytes=args.migrate_page_bytes,
+            wire_gbps=args.migrate_wire_gbps,
+            wire_latency_s=args.migrate_latency_ms / 1e3,
+            loss_bar=args.loss_bar)
+        print(f"\nwhat-if: prefill:decode split at {args.chips} chips "
+              f"(page {args.migrate_page_bytes}B over "
+              f"{args.migrate_wire_gbps:g} Gbit/s + "
+              f"{args.migrate_latency_ms:g} ms/window)")
+        for row in rows:
+            mark = ""
+            if best is not None and row is best:
+                mark = " <-- best split (beats colocated p99)"
+            pre = ("" if row.prefill is None
+                   else f"  [prefill pool: {_fmt_pred(row.prefill)}]")
+            print(f"  {row.describe():<24} {_fmt_pred(row.decode)}"
+                  f"{mark}{pre}")
+        if best is None:
+            print("  colocated wins at this budget — migration wire "
+                  "cost eats the split's head-of-line win")
+        artifact["pool_split"] = {
+            "chips": args.chips,
+            "page_bytes": args.migrate_page_bytes,
+            "wire_gbps": args.migrate_wire_gbps,
+            "wire_latency_s": args.migrate_latency_ms / 1e3,
+            "answer": (best.to_dict() if best is not None else None),
+            "rows": [r.to_dict() for r in rows]}
+
     if args.pool_sweep:
         sizes = [int(s) for s in args.pool_sweep.split(",") if s.strip()]
         best, rows = sm.pool_vs_shed(workload, profile, base, sizes,
@@ -251,11 +304,14 @@ def _whatifs(args, workload, profile, base, artifact) -> None:
 # calibration: record a live run, replay it, compare
 # ---------------------------------------------------------------------------
 
-def _record_calibration_run(args, trace_dir: str) -> dict:
+def _record_calibration_run(args, trace_dir: str, *, tp: int = 1
+                            ) -> dict:
     """A short traced in-process engine run — the measured side of the
     calibration.  Returns the engine geometry the simulator must
     mirror.  Prompts are sized to ONE chunk shape so warmup compiles
-    every executable the measured burst runs."""
+    every executable the measured burst runs.  ``tp`` > 1 runs the
+    same burst tensor-parallel over virtual host devices (the
+    --measure_tp_comm pair)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -276,11 +332,15 @@ def _record_calibration_run(args, trace_dir: str) -> dict:
     model, _ = build_model(args.model, dtype=jnp.bfloat16)
     params = jax.jit(model.init)(
         jax.random.key(0), jnp.zeros((1, args.seq), jnp.int32))["params"]
+    mesh = None
+    if tp > 1:
+        from dtf_tpu.serve import serving_mesh
+        mesh = serving_mesh(tp)
     eng = ServeEngine(model, params, max_batch=slots,
                       max_seq_len=int(args.seq), max_delay_s=0.0,
                       queue_size=max(64, 4 * args.calibrate_requests),
                       kv_page_size=ps, kv_pool_pages=pool_usable + 1,
-                      prefill_chunk=chunk)
+                      prefill_chunk=chunk, mesh=mesh)
     rng = np.random.default_rng(args.seed)
 
     def prompt():
@@ -310,6 +370,63 @@ def _record_calibration_run(args, trace_dir: str) -> dict:
             "chunk_tokens": chunk, "queue_size": max(
                 64, 4 * args.calibrate_requests),
             "warmup_requests": 2}
+
+
+def _ensure_host_devices(n: int) -> None:
+    """The tp=2 measurement run needs >= 2 devices; on a CPU box they
+    are virtual (XLA's host platform device count).  The flag is read
+    at BACKEND INIT (first device query), not at jax import — so
+    setting it here still works even though the package already
+    imported jax.  If the backend initialized earlier with fewer
+    devices, serving_mesh raises its own loud error below."""
+    import os
+    cur = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in cur:
+        return
+    os.environ["XLA_FLAGS"] = (
+        cur + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def _measure_tp_comm(args, artifact) -> float:
+    """Satellite of the Amdahl TP model: measure ``tp_comm_frac``
+    instead of trusting the documented 0.15 default.  Two identical
+    traced bursts — tp=1 and tp=2 — give two median decode-step times;
+    the Amdahl split solves for the non-scaling fraction
+    (:func:`~dtf_tpu.plan.serve_model.measured_tp_comm_frac`).  The
+    result lands in the ``plan_serve_tp_comm_frac`` gauge and replaces
+    ``--tp_comm_frac`` for every what-if in this run."""
+    import dtf_tpu.plan.serve_model as sm
+    from dtf_tpu.cli.trace_main import discover, merge_records
+    from dtf_tpu.obs.registry import default_registry, percentile
+
+    medians = {}
+    for tp in (1, 2):
+        with tempfile.TemporaryDirectory(
+                prefix=f"dtf_tpcomm{tp}_") as tmp:
+            _record_calibration_run(args, tmp, tp=tp)
+            merged = merge_records(discover([tmp]))
+        durs = sorted(float(r.get("dur_s", 0.0)) for r in merged
+                      if r.get("kind") == "span"
+                      and r.get("name") == "serve_decode")
+        # drop the compile-tainted head the same way from_records
+        # does: medians, not means
+        if not durs:
+            raise SystemExit(f"measure_tp_comm: the tp={tp} run traced "
+                             f"no serve_decode spans — nothing to "
+                             f"solve the Amdahl split from")
+        medians[tp] = percentile(durs, 50.0)
+    frac = sm.measured_tp_comm_frac(medians[1], medians[2])
+    default_registry().gauge("plan_serve_tp_comm_frac").set(frac)
+    print(f"measured tp_comm_frac: {frac:.3f}  (decode step "
+          f"{medians[1] * 1e3:.2f} ms @ tp=1 -> "
+          f"{medians[2] * 1e3:.2f} ms @ tp=2; "
+          f"--tp_comm_frac {args.tp_comm_frac:g} overridden)")
+    artifact["tp_comm_measurement"] = {
+        "decode_step_s_tp1": medians[1],
+        "decode_step_s_tp2": medians[2],
+        "tp_comm_frac": frac,
+        "default_overridden": float(args.tp_comm_frac)}
+    return frac
 
 
 def _calibrate(args, artifact) -> int:
@@ -387,6 +504,9 @@ def main(argv=None) -> int:
     artifact: dict = {"argv": list(sys.argv[1:] if argv is None
                                    else argv)}
     rc = 0
+    if args.measure_tp_comm:
+        _ensure_host_devices(2)
+        args.tp_comm_frac = _measure_tp_comm(args, artifact)
     if args.calibrate:
         rc = _calibrate(args, artifact)
     else:

@@ -119,6 +119,7 @@ def run_router(cfg, random_init: bool = False) -> dict:
         respawn_window_s=cfg.router_respawn_window_s,
         respawn_backoff_s=cfg.router_respawn_backoff_s,
         hedge_s=cfg.router_hedge_s,
+        prefill_replicas=cfg.router_prefill_replicas,
         seed=cfg.seed)
 
     def _on_sigterm(signum, frame):

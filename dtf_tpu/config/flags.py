@@ -339,6 +339,13 @@ class Config:
     # digest to the replica whose PrefixRegistry is warm, least-loaded
     # fallback) | least_loaded | random (the bench A/B arm)
     router_placement: str = "affinity"
+    # disaggregation: replicas 0..N-1 form a prefill-specialized pool,
+    # the rest a decode pool — cold prompts prefill in the first,
+    # their KV-page chains migrate over the wire (serve/migrate.py)
+    # and re-home to the second, so warm shared-prefix traffic decodes
+    # prefill-free.  Needs router_placement=affinity.  0 = colocated
+    # (the default: every replica does both, no migration)
+    router_prefill_replicas: int = 0
     # rendezvous directory for announce + heartbeat files (router +
     # cli/replica_main); "" = router_main picks a temp dir.  Put it on
     # SHARED storage and the tier goes cross-host: replicas announce
@@ -588,6 +595,19 @@ class Config:
             raise ValueError(
                 f"unknown router_placement {self.router_placement!r}; "
                 f"choose from ('affinity', 'least_loaded', 'random')")
+        if self.router_prefill_replicas < 0 or (
+                self.router_prefill_replicas >= self.router_replicas
+                and self.router_prefill_replicas > 0):
+            raise ValueError(
+                f"router_prefill_replicas "
+                f"({self.router_prefill_replicas}) must leave at least "
+                f"one decode replica (router_replicas="
+                f"{self.router_replicas})")
+        if (self.router_prefill_replicas
+                and self.router_placement != "affinity"):
+            raise ValueError(
+                "router_prefill_replicas needs router_placement="
+                "affinity — chain re-homing rides the prefix-owner map")
         if (self.router_replica_inflight < 0 or self.router_max_respawns
                 < 0 or self.router_respawn_backoff_s < 0
                 or self.router_hedge_s < 0):

@@ -35,6 +35,10 @@ KNOWN_ANOMALY_KINDS = (
     # zero-downtime rollout (dtf_tpu/serve/rollout.py): the canary
     # gate's verdicts and the rollback record
     "canary_divergence", "rollout_rollback", "rollout_rollback_failed",
+    # disaggregated serving's KV-page wire migration (serve/migrate.py
+    # detects torn transfers; serve/router.py flags migrations that
+    # never made it — an efficiency loss, never a lost request)
+    "migration_torn", "migration_failed",
 )
 
 #: event kinds of the run/request-timeline / ledger / profiler layer —
@@ -62,6 +66,8 @@ KNOWN_EVENT_KINDS = (
     # control surface)
     "rollout_phase", "replica_drain", "replica_replaced",
     "canary_mirror", "canary_compare", "canary_drop", "prefix_rehome",
+    # disaggregation: the router's chain re-home command + completion
+    "chain_migrate", "chain_migrated",
     # MFU/cost ledger (obs/ledger.py)
     "ledger_exec", "ledger_summary",
     # ZeRO compute/comm overlap probe (train/loop.py --zero_probe)
@@ -81,7 +87,7 @@ KNOWN_EVENT_KINDS = (
 CHAOS_FAULT_KINDS = (
     "crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
     "reader_crash", "replica_kill", "net_partition", "slow_replica",
-    "rollout_kill", "device_loss", "host_loss",
+    "rollout_kill", "device_loss", "host_loss", "page_fetch_stall",
 )
 
 #: metric-name grammar: <subsystem>_<name>[_<unit-ish suffix>], where

@@ -49,9 +49,25 @@ Deadlines are post-hoc verdicts: a request whose simulated completion
 exceeds its deadline counts as a deadline failure (its tokens don't
 count toward throughput).  The real router frees capacity at the
 deadline instead of at completion, so the simulator is conservative.
-Hedging is accepted and recorded but a no-op under deterministic
-service times (nothing straggles); the knob exists so ranked configs
-round-trip the full policy surface.
+Service times are jittered from the MEASURED per-step spread when the
+profile carries one (``ServeProfile.jitter`` — the recorded
+``serve_decode`` span durations normalized by their median,
+resampled by a seeded in-module PRNG so predictions stay
+deterministic); with jitter on, ``hedge_s`` is a real policy: a
+request stuck in a straggling replica's queue past the hedge bar is
+re-dispatched to a strictly less-loaded sibling, the simulator's
+model of the router's duplicate-dispatch race.  Without jitter
+nothing straggles and the knob stays a recorded no-op.
+
+Disaggregation is a what-if (:func:`pool_split`): at a fixed chip
+budget, compare the colocated tier against every prefill:decode
+replica split.  The decode pool's "prefill" is KV-page MIGRATION —
+each chunk-equivalent of prompt pages crosses the fabric at a
+documented wire bandwidth plus a per-window latency
+(``serve/migrate.py``'s windowed ``page_fetch`` protocol,
+miniaturized) — so the trade the model captures is real: a split
+buys the decode pool freedom from prefill head-of-line blocking and
+pays for it in wire time and a thinner decode fleet.
 
 Calibration contract (the PR-5 ``--calibrate`` shape): predicted
 tokens/s and p99 latency must land within a documented ratio bar
@@ -76,6 +92,12 @@ from dtf_tpu.plan.serve_trace import Workload
 #: a workload when sheds + deadline failures stay under this fraction
 DEFAULT_LOSS_BAR = 0.01
 
+#: jitter extraction: need at least this many decode spans for the
+#: spread to mean anything, keep about this many (evenly strided
+#: over the SORTED durations, so the tails survive the cap)
+_JITTER_MIN_SPANS = 8
+_JITTER_SAMPLES = 256
+
 
 @dataclasses.dataclass(frozen=True)
 class ServeProfile:
@@ -86,7 +108,12 @@ class ServeProfile:
     is why the simulator charges it per ITERATION, not per token);
     ``prefill_chunk_s`` is one ``chunk_tokens``-token prefill chunk.
     ``overhead_s`` is per engine iteration (host-side scheduling not
-    inside either span)."""
+    inside either span).  ``jitter`` is the measured per-step spread:
+    each recorded decode span's duration divided by the stream's
+    median, sorted — the simulator resamples it per iteration so
+    stragglers happen at their MEASURED frequency, not a modeled
+    one.  Empty = deterministic service times (the pre-calibration
+    default)."""
 
     decode_step_s: float
     prefill_chunk_s: float
@@ -96,6 +123,7 @@ class ServeProfile:
     decode_flops: float = 0.0
     tp_base: int = 1
     tp_comm_frac: float = 0.15
+    jitter: Tuple[float, ...] = ()
 
     def __post_init__(self):
         if self.decode_step_s <= 0 or self.prefill_chunk_s <= 0:
@@ -107,6 +135,11 @@ class ServeProfile:
         if not 0.0 <= self.tp_comm_frac < 1.0:
             raise ValueError(f"tp_comm_frac must be in [0, 1), got "
                              f"{self.tp_comm_frac}")
+        # lists parse out of JSON artifacts; store the canonical tuple
+        object.__setattr__(self, "jitter", tuple(self.jitter))
+        if any(j <= 0 for j in self.jitter):
+            raise ValueError("jitter factors must be positive "
+                             "(dur / median of measured spans)")
 
     def decode_step_for(self, tp: int) -> float:
         """Amdahl model of TP scaling around the measured base: the
@@ -134,8 +167,11 @@ class ServeProfile:
         ``serve_decode`` / ``serve_prefill_chunk`` span wall times
         (median, not mean — the stream includes the compile-step
         outliers the ledger drops), modal chunk size from the chunk
-        spans, per-step flops from the ledger.  ``overrides`` win over
-        extracted values (and supply anything the trace lacks)."""
+        spans, per-step flops from the ledger, and the decode spans'
+        normalized spread as the ``jitter`` distribution (capped at
+        ``_JITTER_SAMPLES`` evenly-strided samples so a long trace
+        doesn't bloat the profile).  ``overrides`` win over extracted
+        values (and supply anything the trace lacks)."""
         decode_durs: List[float] = []
         chunk_durs: List[float] = []
         chunk_sizes: List[int] = []
@@ -153,7 +189,13 @@ class ServeProfile:
                 flops = float(rec.get("flops", 0.0) or 0.0)
         values: Dict[str, object] = {}
         if decode_durs:
-            values["decode_step_s"] = percentile(sorted(decode_durs), 50.0)
+            med = percentile(sorted(decode_durs), 50.0)
+            values["decode_step_s"] = med
+            if med > 0 and len(decode_durs) >= _JITTER_MIN_SPANS:
+                facs = sorted(round(d / med, 6) for d in decode_durs
+                              if d > 0)
+                stride = max(1, len(facs) // _JITTER_SAMPLES)
+                values["jitter"] = tuple(facs[::stride])
         if chunk_durs:
             values["prefill_chunk_s"] = percentile(sorted(chunk_durs),
                                                    50.0)
@@ -189,9 +231,14 @@ class FleetConfig:
     deadline_s: float = 120.0
     replica_inflight: int = 16
     placement: str = "affinity"      # affinity | least_loaded
-    hedge_s: float = 0.0             # recorded; no-op: service times
-                                     # are deterministic, nothing
-                                     # straggles for a hedge to beat
+    hedge_s: float = 0.0             # queue-escape bar: with measured
+                                     # jitter in the profile, a request
+                                     # pending longer than this moves to
+                                     # a strictly less-loaded replica
+                                     # (the duplicate-dispatch race,
+                                     # resolved in the winner's favor);
+                                     # without jitter nothing straggles
+                                     # and the knob is a recorded no-op
     pool_scales_with_tp: bool = True
 
     def __post_init__(self):
@@ -244,6 +291,8 @@ class FleetPrediction:
     deadline_rate: float
     replica_utilization: float
     span_s: float
+    hedged: int = 0                  # requests re-dispatched by the
+                                     # hedge queue-escape (jitter runs)
 
     @property
     def loss_rate(self) -> float:
@@ -272,7 +321,7 @@ class _Slot:
 
 class _SimReq:
     __slots__ = ("rec", "arrival", "budget", "admit_t", "finish_t",
-                 "outcome")
+                 "outcome", "placed_t")
 
     def __init__(self, rec):
         self.rec = rec
@@ -283,6 +332,7 @@ class _SimReq:
         self.admit_t = None
         self.finish_t = None
         self.outcome = None
+        self.placed_t = None        # last placed on a replica (hedge)
 
 
 class _SimReplica:
@@ -307,12 +357,26 @@ class _SimReplica:
 def simulate(workload: Workload, profile: ServeProfile,
              config: FleetConfig) -> FleetPrediction:
     """Replay ``workload`` through the fleet model.  Deterministic:
-    same inputs, same prediction."""
+    same inputs, same prediction — jitter resampling runs off a fixed-
+    seed in-module PRNG, not wall-clock entropy."""
     ps = profile.page_size
     step_s = profile.decode_step_for(config.tp)
     chunk_s = profile.prefill_chunk_for(config.tp)
     chunk_tokens = profile.chunk_tokens
     pool = config.usable_pages
+    jit = profile.jitter
+    jit_state = 0x9E3779B97F4A7C15
+    hedged_n = 0
+
+    def jitter_factor() -> float:
+        # 64-bit LCG (Knuth MMIX constants) indexing the EMPIRICAL
+        # distribution — same spread the trace measured, no parametric
+        # assumption, and no numpy dependency to keep determinism
+        # hostage to a library version
+        nonlocal jit_state
+        jit_state = (jit_state * 6364136223846793005
+                     + 1442695040888963407) & 0xFFFFFFFFFFFFFFFF
+        return jit[(jit_state >> 33) % len(jit)]
 
     reqs = [_SimReq(r) for r in workload.requests]
     if not reqs:
@@ -337,12 +401,48 @@ def simulate(workload: Workload, profile: ServeProfile,
     def shed(sr: _SimReq) -> None:
         sr.outcome = "shed"
 
+    def rehedge(now: float) -> None:
+        """The hedge policy, as the simulator can honor it: a request
+        pending on one replica past ``hedge_s`` while a strictly
+        less-loaded sibling has queue room is re-dispatched there —
+        the router's duplicate-dispatch race, resolved in the winner's
+        favor (optimistic: the loser's wasted work is not charged).
+        Only meaningful under measured jitter; deterministic service
+        never leaves a request stuck behind a straggler."""
+        nonlocal hedged_n
+        if config.hedge_s <= 0 or not jit:
+            return
+        for rep in reps:
+            for sr in [s for s in rep.pending
+                       if now - s.placed_t > config.hedge_s]:
+                # the move must strictly improve balance (target ends
+                # no more loaded than the source does) — that
+                # monotonicity is what rules out hedge ping-pong
+                tgt = [r2 for r2 in reps if r2 is not rep
+                       and len(r2.pending) < config.queue_size
+                       and r2.inflight + 2 <= rep.inflight]
+                if not tgt:
+                    break
+                r2 = min(tgt, key=lambda r: (r.inflight, r.rid))
+                rep.pending.remove(sr)
+                rep.inflight -= 1
+                r2.pending.append(sr)
+                r2.inflight += 1
+                sr.placed_t = now
+                hedged_n += 1
+                if sr.rec.prefix_group is not None:
+                    owner[sr.rec.prefix_group] = r2.rid
+                if not r2.scheduled:
+                    r2.scheduled = True
+                    heapq.heappush(events, (now, next(seq), "iter", r2))
+
     def dispatch(now: float) -> None:
         """The router's dispatch scan: place every queued request an
         eligible replica can take; shed what EVERY replica's queue has
         no room for (the Backpressure-relay contract — waiting there
         is a retry storm, not a queue)."""
         nonlocal outstanding
+        rehedge(now)
         placed = []
         for sr in router_q:
             eligible = [rep for rep in reps
@@ -369,6 +469,7 @@ def simulate(workload: Workload, profile: ServeProfile,
                 owner[group] = rep.rid
             rep.pending.append(sr)
             rep.inflight += 1
+            sr.placed_t = now
             placed.append(sr)
             if not rep.scheduled:
                 rep.scheduled = True
@@ -471,9 +572,9 @@ def simulate(workload: Workload, profile: ServeProfile,
             return              # idle until the next dispatch wakes it
         dt = profile.overhead_s
         if prefilling:
-            dt += chunk_s
+            dt += chunk_s * (jitter_factor() if jit else 1.0)
         if decoding:
-            dt += step_s
+            dt += step_s * (jitter_factor() if jit else 1.0)
         rep.busy_s += dt
         t2 = now + dt
         if prefilling:
@@ -551,10 +652,11 @@ def simulate(workload: Workload, profile: ServeProfile,
             replica_utilization=(sum(r.busy_s for r in reps)
                                  / (len(reps) * full_span))
             if full_span > 0 else 0.0,
-            span_s=span)
+            span_s=span, hedged=hedged_n)
     return FleetPrediction(0.0, 0.0, 0.0, 0.0, 0.0, 0, shed_n, dead_n,
                            shed_n / total if total else 0.0,
-                           dead_n / total if total else 0.0, 0.0, 0.0)
+                           dead_n / total if total else 0.0, 0.0, 0.0,
+                           hedged=hedged_n)
 
 
 # ---------------------------------------------------------------------------
@@ -698,6 +800,148 @@ def pool_vs_shed(workload: Workload, profile: ServeProfile,
     best = next((p for p, pred in rows
                  if pred.completed and pred.loss_rate <= loss_bar), None)
     return best, rows
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolSplitRow:
+    """One row of :func:`pool_split`.  ``prefill_replicas == 0`` is the
+    colocated baseline: ``decode`` then holds the whole tier's
+    prediction and ``prefill`` is None (no second pool exists)."""
+
+    prefill_replicas: int
+    decode_replicas: int
+    migrate_chunk_s: float
+    decode: FleetPrediction
+    prefill: Optional[FleetPrediction] = None
+
+    @property
+    def is_colocated(self) -> bool:
+        return self.prefill_replicas == 0
+
+    @property
+    def loss_rate(self) -> float:
+        """A request is lost if EITHER pool loses it."""
+        if self.prefill is None:
+            return self.decode.loss_rate
+        return max(self.decode.loss_rate, self.prefill.loss_rate)
+
+    def describe(self) -> str:
+        if self.is_colocated:
+            return f"colocated ({self.decode_replicas} replicas)"
+        return (f"{self.prefill_replicas}p:"
+                f"{self.decode_replicas}d split")
+
+    def to_dict(self) -> dict:
+        return {"prefill_replicas": self.prefill_replicas,
+                "decode_replicas": self.decode_replicas,
+                "migrate_chunk_s": self.migrate_chunk_s,
+                "loss_rate": self.loss_rate,
+                "decode": self.decode.to_dict(),
+                "prefill": (self.prefill.to_dict()
+                            if self.prefill is not None else None)}
+
+
+def pool_split(workload: Workload, profile: ServeProfile,
+               config: FleetConfig, chips: int, *,
+               page_bytes: int = 1 << 20, wire_gbps: float = 10.0,
+               wire_latency_s: float = 0.002,
+               loss_bar: float = DEFAULT_LOSS_BAR
+               ) -> Tuple[Optional[PoolSplitRow], List[PoolSplitRow]]:
+    """Disaggregation what-if: at a fixed chip budget, colocated vs
+    every prefill:decode replica split (tp held at ``config.tp``).
+
+    The split is modeled as two independent fleets fed the same
+    arrival process:
+
+      prefill pool — the workload with every decode budget cut to the
+          single token prefill emits (the chain then LEAVES: finished
+          prefills migrate out, so the pool's only decode work is
+          first tokens).
+      decode pool  — the full workload, with prefill chunks replaced
+          by MIGRATION chunks: the same prompt pages arrive over the
+          fabric at ``wire_gbps`` (decimal Gbit/s) plus a
+          ``wire_latency_s`` window round-trip per chunk — the cost
+          shape of ``serve/migrate.py``'s windowed ``page_fetch``
+          protocol.  Prefix affinity still applies (a shared prefix
+          migrates once, later requests hit the registry).
+
+    End-to-end latency does not compose across the two simulations
+    (each pool queues independently), so the ranking criterion is the
+    DECODE pool's p99 — time-between-tokens is what disaggregation
+    buys; the prefill pool only has to stay feasible under the loss
+    bar.  ``best`` is the feasible split with the lowest decode p99
+    that strictly beats colocated p99 at equal chips, or None when
+    colocated wins (the honest verdict: migration is not free).
+
+    Returns ``(best, rows)`` — ``rows[0]`` is the colocated
+    baseline."""
+    if chips < 2:
+        raise ValueError(f"pool_split needs chips >= 2 (one replica "
+                         f"cannot split), got {chips}")
+    if chips % config.tp != 0:
+        raise ValueError(f"chips ({chips}) must be a multiple of "
+                         f"config.tp ({config.tp}) — the split is in "
+                         f"whole replicas")
+    if page_bytes < 1 or wire_gbps <= 0 or wire_latency_s < 0:
+        raise ValueError("page_bytes must be >= 1, wire_gbps positive, "
+                         "wire_latency_s non-negative")
+    n = chips // config.tp
+    if n < 2:
+        raise ValueError(f"chips/tp leaves {n} replica(s) — nothing "
+                         f"to split")
+    # one chunk-equivalent of prompt pages over the fabric: payload
+    # time at wire bandwidth plus one window round-trip
+    wire_bytes_per_s = wire_gbps * 1e9 / 8.0
+    mig_chunk_s = (wire_latency_s
+                   + (profile.chunk_tokens / profile.page_size)
+                   * page_bytes / wire_bytes_per_s)
+    colocated = simulate(workload, profile,
+                         dataclasses.replace(config, replicas=n))
+    rows = [PoolSplitRow(0, n, 0.0, colocated)]
+    prefill_w = Workload(
+        [dataclasses.replace(r, decode_tokens=1)
+         for r in workload.requests],
+        workload.duration_s, workload.source + ":prefill_pool",
+        workload.skipped_no_trace)
+    decode_profile = dataclasses.replace(profile,
+                                         prefill_chunk_s=mig_chunk_s)
+    for p in range(1, n):
+        d = n - p
+        pre = simulate(prefill_w, profile,
+                       dataclasses.replace(config, replicas=p))
+        dec = simulate(workload, decode_profile,
+                       dataclasses.replace(config, replicas=d))
+        rows.append(PoolSplitRow(p, d, mig_chunk_s, dec, pre))
+    feasible = [r for r in rows[1:]
+                if r.decode.completed and r.loss_rate <= loss_bar
+                and r.decode.latency_p99_s < colocated.latency_p99_s]
+    best = min(feasible,
+               key=lambda r: (r.decode.latency_p99_s,
+                              -r.decode.tokens_per_s),
+               default=None)
+    return best, rows
+
+
+def measured_tp_comm_frac(t_base: float, t_scaled: float, *,
+                          tp_base: int = 1, tp_scaled: int = 2
+                          ) -> float:
+    """Solve the Amdahl split for ``tp_comm_frac`` from two MEASURED
+    decode-step times instead of trusting the documented default:
+    ``t(tp) = t(base) · (f + (1 − f) · base/tp)`` gives
+    ``f = (t_scaled/t_base − base/scaled) / (1 − base/scaled)``.
+
+    Clamped into the profile's valid domain: a super-linear speedup
+    measures as 0.0 (all compute), a SLOWDOWN under TP clamps at 0.95
+    rather than rejecting — the planner should still rank with the
+    pessimistic number, not die on a noisy box."""
+    if t_base <= 0 or t_scaled <= 0:
+        raise ValueError("measured step times must be positive")
+    if tp_scaled <= tp_base:
+        raise ValueError(f"tp_scaled ({tp_scaled}) must exceed "
+                         f"tp_base ({tp_base})")
+    share = tp_base / tp_scaled
+    frac = (t_scaled / t_base - share) / (1.0 - share)
+    return min(max(frac, 0.0), 0.95)
 
 
 # ---------------------------------------------------------------------------

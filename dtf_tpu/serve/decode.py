@@ -246,6 +246,13 @@ class Decoder:
                 lambda cache, src, dst: jax.tree_util.tree_map(
                     lambda c: c.at[dst].set(c[src]), cache),
                 donate_argnums=(0,))
+            # migration import: write a host page payload (one
+            # [page_size, H, Dh] row per leaf) into pool page ``dst``
+            self._write_page = jax.jit(
+                lambda cache, dst, payload: jax.tree_util.tree_map(
+                    lambda c, p: c.at[dst].set(p.astype(c.dtype)),
+                    cache, payload),
+                donate_argnums=(0,))
         else:
             self.model = make_decode_model(model)
             self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,))
@@ -333,6 +340,34 @@ class Decoder:
         onto a fresh page first)."""
         return self._copy_page(cache, jnp.asarray(src, jnp.int32),
                                jnp.asarray(dst, jnp.int32))
+
+    def read_page(self, cache, page: int):
+        """Host copy of pool page ``page`` from every layer's K and V
+        pool — the migration EXPORT primitive (serve/migrate.py).
+        Returns a flat LIST of [page_size, H, Dh] numpy leaves in
+        ``tree_leaves`` order (deterministic for a given model, so the
+        sender's list zips onto the receiver's cache leaves).  Pure
+        device_get, no casts or layout changes: the bytes are exactly
+        what the device holds, which is what the bit-identity contract
+        on migrated pages is built on."""
+        if not self.paged:
+            raise RuntimeError("page migration needs the paged cache")
+        return [np.asarray(jax.device_get(c[int(page)]))
+                for c in jax.tree_util.tree_leaves(cache)]
+
+    def write_page(self, cache, page: int, leaves):
+        """Write a host page payload (:meth:`read_page`'s leaf list)
+        into pool page ``page`` of every layer — the migration IMPORT
+        primitive.  The pool's page dim is unsharded under TP (the
+        head dim shards), so a whole-page write lowers to shard-local
+        updates, same as :meth:`copy_page`."""
+        if not self.paged:
+            raise RuntimeError("page migration needs the paged cache")
+        treedef = jax.tree_util.tree_structure(cache)
+        payload = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in leaves])
+        return self._write_page(cache, jnp.asarray(int(page), jnp.int32),
+                                payload)
 
     @property
     def compiled_count(self) -> int:

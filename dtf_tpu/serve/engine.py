@@ -634,6 +634,24 @@ class ServeEngine:
         # streaming: engine-emit → consumer-receive delay per token
         self._m_stream_lag = self.metrics.histogram("serve_stream_lag_s",
                                                     unit="s")
+        # KV-page migration (serve/migrate.py): pages shipped out /
+        # pulled in over the replica wire, live migration holds (pages
+        # pinned above eviction while a transfer is in flight), and
+        # torn transfers caught by the payload digest
+        self._m_pages_exported = self.metrics.counter(
+            "serve_pages_exported_total", unit="pages")
+        self._m_pages_imported = self.metrics.counter(
+            "serve_pages_imported_total", unit="pages")
+        self._m_mig_holds = self.metrics.gauge("serve_migration_holds",
+                                               unit="pages")
+        self._m_mig_torn = self.metrics.counter(
+            "serve_migration_torn_total", unit="pages")
+        # migration jobs: wire threads enqueue closures here; the
+        # engine thread drains the queue once per iteration, so every
+        # pool/registry/_cache touch stays single-writer (the queue is
+        # a thread-safe queue.Queue — not _cond-guarded state)
+        self._mig_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._mig_hold_pages = 0        # engine-thread only
         # cancellation: requests whose caller stopped wanting the
         # answer (deadline-exceeded, failed-over, losing hedge) —
         # each one freed a slot + pages that would otherwise decode
@@ -678,6 +696,147 @@ class ServeEngine:
             if self.pool is not None:
                 self.pool.high_water = self.pool.used_pages
             return len(self.completed)
+
+    # -- KV-page migration surface (serve/migrate.py) ------------------
+    # Every entry point below MARSHALS its work onto the engine thread
+    # (run_on_engine): the pool, registry and cache are single-writer
+    # engine-thread state, and migration must serialize with admission,
+    # eviction and retire — not race them.  Wire threads block on the
+    # job's completion; the engine loop drains the job queue once per
+    # iteration (≤0.1s latency when idle).
+
+    def _run_migration_jobs(self):
+        """Engine thread: run queued migration closures."""
+        while True:
+            try:
+                fn, box, ev = self._mig_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001 — the error belongs
+                # to the waiting wire thread, never the engine loop
+                box["error"] = e
+            ev.set()
+
+    def run_on_engine(self, fn, timeout: float = 60.0):
+        """Run ``fn()`` on the engine thread; return its result (or
+        re-raise its exception) in the calling thread.  Deadlocks by
+        construction if called FROM the engine thread — callers are
+        wire/client threads only."""
+        if self._stop.is_set():
+            raise RuntimeError("engine is stopped")
+        box: dict = {}
+        ev = threading.Event()
+        self._mig_q.put((fn, box, ev))
+        with self._cond:
+            self._cond.notify_all()      # wake an idle engine loop
+        if not ev.wait(timeout):
+            raise TimeoutError(f"engine job not run in {timeout}s")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def _chain_digests(self, prompt: np.ndarray, depths: int) -> List[str]:
+        ps = self.page_size
+        out: List[str] = []
+        digest = ""
+        for d in range(depths):
+            digest = _page_digest(digest, prompt[d * ps:(d + 1) * ps])
+            out.append(digest)
+        return out
+
+    def export_chain_begin(self, prompt) -> Tuple[List[int], List[str]]:
+        """Look up the registry's verified page chain for ``prompt``
+        and take a MIGRATION HOLD on it (one extra pool holder per
+        page).  Held pages have refcount ≥ 2, which puts them above
+        ``_evict_for``'s refcount-1 bar — an in-transfer page can never
+        be evicted, by construction, not by bookkeeping.  Returns
+        (pages, chained digests); release with
+        :meth:`export_chain_end` (transfer done OR aborted — the hold
+        must not outlive its transfer)."""
+        if not self.paged or not self.prefix_sharing:
+            return [], []
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+
+        def job():
+            pages = self.registry.lookup(prompt)
+            self.pool.share(pages)
+            self._mig_hold_pages += len(pages)
+            self._m_mig_holds.set(self._mig_hold_pages)
+            return pages, self._chain_digests(prompt, len(pages))
+
+        return self.run_on_engine(job)
+
+    def export_chain_read(self, pages: List[int], lo: int, n: int):
+        """Host payloads (decoder leaf lists) for ``pages[lo:lo+n]`` —
+        one bounded window of an in-flight transfer.  The caller must
+        hold the chain (export_chain_begin): the window read trusts
+        that the physical pages still carry the chain's KV."""
+        def job():
+            out = [self.decoder.read_page(self._cache, p)
+                   for p in pages[lo:lo + n]]
+            self._m_pages_exported.inc(len(out))
+            return out
+
+        return self.run_on_engine(job)
+
+    def export_chain_end(self, pages: List[int]) -> None:
+        """Drop the migration hold (transfer complete or aborted)."""
+        if not pages:
+            return
+
+        def job():
+            for p in self.pool.free(pages):
+                self.registry.drop_page(p)
+            self._mig_hold_pages -= len(pages)
+            self._m_mig_holds.set(self._mig_hold_pages)
+
+        self.run_on_engine(job)
+
+    def import_chain(self, prompt, payloads) -> int:
+        """Write a fetched page chain (``payloads[d]`` = decoder leaf
+        list for depth d, verified by the caller) into the local pool
+        and register it, so the next admit of this prompt prefix
+        SHARES the migrated pages instead of prefilling.  Depths the
+        local registry already holds are skipped.  Ownership
+        transfers: the fresh pages' alloc holder becomes the
+        registry's holder — after import the pages are ordinary warm
+        registry pages (refcount 1, evictable under pressure).
+        Returns the number of pages imported."""
+        if not self.paged or not self.prefix_sharing:
+            raise RuntimeError("page import needs the paged cache with "
+                               "prefix sharing on")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+
+        def job():
+            existing = self.registry.lookup(prompt)
+            todo = payloads[len(existing):]
+            if not todo:
+                return 0
+            need = len(todo)
+            pages = self.pool.alloc(need)
+            if pages is None:
+                self._evict_for(need)
+                pages = self.pool.alloc(need)
+            if pages is None:
+                raise RuntimeError(
+                    f"import starved: {need} pages needed, "
+                    f"{self.pool.free_pages} free")
+            for page, leaves in zip(pages, todo):
+                self._cache = self.decoder.write_page(self._cache, page,
+                                                      leaves)
+            fresh = self.registry.register(prompt, existing + pages)
+            # pages the registry refused (key raced in / collision
+            # guard) go straight back — nothing may own an
+            # unregistered imported page
+            stray = [p for p in pages if p not in fresh]
+            for p in self.pool.free(stray):
+                self.registry.drop_page(p)
+            self._m_pages_imported.inc(len(fresh))
+            return len(fresh)
+
+        return self.run_on_engine(job)
 
     # -- client side ---------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -793,9 +952,21 @@ class ServeEngine:
         return self.submit(prompt, **kw).result(timeout=600)
 
     # -- engine thread -------------------------------------------------
+    def _drain_migration_jobs(self):
+        """Engine exit: fail queued migration jobs instead of leaving
+        their wire threads to time out against a dead loop."""
+        while True:
+            try:
+                _, box, ev = self._mig_q.get_nowait()
+            except queue_mod.Empty:
+                return
+            box["error"] = RuntimeError("engine is stopped")
+            ev.set()
+
     def _loop(self):
         try:
             self._loop_body()
+            self._drain_migration_jobs()
         except Exception:
             # a dead engine thread must not strand clients blocked in
             # result(): fail loudly and deliver cancellations
@@ -813,6 +984,7 @@ class ServeEngine:
                     request_id=req.id, tokens=[], prompt_len=0,
                     queue_wait_s=0.0, time_to_first_token_s=0.0,
                     latency_s=0.0, cancelled=True))
+            self._drain_migration_jobs()
 
     def _loop_body(self):
         while True:
@@ -820,6 +992,11 @@ class ServeEngine:
                 # serving liveness: the beat interval gate is inside
                 # beat(), so this is one clock read per iteration
                 self._heartbeat.beat(step=self._m_completed.value)
+            # migration jobs run HERE, on the engine thread, between
+            # iterations: exports/imports touch the pool, registry and
+            # cache, which are single-writer engine-thread state — a
+            # wire thread mutating them directly would race _retire
+            self._run_migration_jobs()
             with self._cond:
                 # cancellation sweep (queued half): a cancelled request
                 # that never reached a slot resolves right here —

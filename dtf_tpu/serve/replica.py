@@ -33,6 +33,23 @@ speaks a newline-delimited-JSON wire protocol over TCP:
                                         hedge)
     {"op":"drain"}                      stop admissions, finish in-flight
     {"op":"stats"}                      request a stats snapshot
+    {"op":"reset_measurement"}          zero decode-gap/peak stats
+                                        (bench warmup exclusion)
+    {"op":"migrate_in","xfer":X,"host":H,"port":P,"prompt":[...]}
+                                        pull this prompt's KV page
+                                        chain from the replica at H:P
+                                        and import it locally (the
+                                        router re-homing a finished
+                                        chain onto a decode replica —
+                                        serve/migrate.py)
+
+  peer replica (or router) → replica           KV-page migration
+    {"op":"page_fetch","xfer":X,"prompt":[...],"lo":L,"n":N}
+                                        serve window [L, L+N) of the
+                                        prompt's page chain; the first
+                                        fetch takes a migration hold
+                                        on the whole chain
+    {"op":"page_fetch","xfer":X,"release":true}   drop the hold
 
   replica → router
     {"op":"token","id":W,"token":T,"i":I}   token I of request W retired
@@ -40,6 +57,15 @@ speaks a newline-delimited-JSON wire protocol over TCP:
     {"op":"backpressure","id":W,"retry_after":S}  engine shed it
     {"op":"error","id":W,"error":MSG}       engine rejected it
     {"op":"stats",...}                      stats snapshot
+    {"op":"migrated","xfer":X,"ok":B,"pages":N,...}  migrate_in result
+
+  replica → peer replica
+    {"op":"page_push","xfer":X,"depth":D,"digest":C,"tokens":[...],
+     "payload":{...},"chain_len":L}     one chain page (+ end-of-
+                                        window / error markers —
+                                        serve/migrate.py has the full
+                                        grammar and the verification
+                                        contract)
 
 RENDEZVOUS is file-based, deliberately: the replica binds an EPHEMERAL
 port (no port-allocation coordination, no TOCTOU between picking and
@@ -76,6 +102,7 @@ from typing import Optional
 
 import numpy as np
 
+from dtf_tpu.serve import migrate
 from dtf_tpu.serve.engine import Backpressure
 
 log = logging.getLogger("dtf_tpu")
@@ -249,6 +276,11 @@ class ReplicaServer:
         # a reconnected router's cancels can only name work it
         # dispatched on THIS connection; entries die with the request)
         handles: dict = {}
+        # xfer id -> in-flight chain export (pages under migration
+        # hold).  Per connection, so a client that vanishes releases
+        # its holds in the finally below — a dead peer cannot pin
+        # pages forever
+        exports: dict = {}
         try:
             for line in rfile:
                 if self._stop.is_set():
@@ -272,12 +304,34 @@ class ReplicaServer:
                     stats = self._stats()
                     stats["tag"] = msg.get("tag", "")
                     outq.put(stats)
+                elif op == "reset_measurement":
+                    if hasattr(self.engine, "reset_measurement"):
+                        self.engine.reset_measurement()
+                elif op == "page_fetch":
+                    self._handle_page_fetch(msg, outq, exports)
+                elif op == "migrate_in":
+                    # own thread: fetch_chain blocks on the peer's
+                    # socket + engine jobs, and this wire loop must
+                    # keep serving submits/cancels meanwhile
+                    threading.Thread(
+                        target=self._handle_migrate_in,
+                        args=(msg, outq), daemon=True,
+                        name=f"replica{self.replica_id}-migrate").start()
                 else:
                     log.warning("replica %d: unknown op %r",
                                 self.replica_id, op)
         except OSError:
             pass
         finally:
+            for st in exports.values():
+                # the peer vanished mid-transfer: its migration holds
+                # die with the connection
+                try:
+                    self.engine.export_chain_end(st["pages"])
+                except Exception:  # noqa: BLE001 — teardown must not
+                    # raise into the accept machinery
+                    log.exception("replica %d: export-hold release "
+                                  "failed", self.replica_id)
             dead.set()
             outq.put(None)
             try:
@@ -296,11 +350,102 @@ class ReplicaServer:
         if metrics is not None:
             for name in ("serve_completed_total", "serve_shed_total",
                          "serve_prefix_hit_pages_total",
-                         "serve_prefix_cow_total"):
+                         "serve_prefix_cow_total",
+                         "serve_pages_exported_total",
+                         "serve_pages_imported_total",
+                         "serve_migration_torn_total",
+                         "serve_prefill_chunks_total"):
                 m = metrics.get(name)
                 if m is not None:
                     out[name] = m.value
+            gap = metrics.get("serve_decode_gap_s")
+            if gap is not None:
+                # per-replica decode-gap tail: the pool-role
+                # comparison number (bench_serve's disaggregated-vs-
+                # colocated bar reads it over the wire)
+                out["serve_decode_gap_p99"] = gap.percentile(99.0)
+                out["serve_decode_gap_count"] = gap.count
         return out
+
+    # -- KV-page migration (serve/migrate.py) --------------------------
+    def _handle_page_fetch(self, msg: dict, outq, exports: dict) -> None:
+        """Serve one window of a chain export — or release the hold.
+        Runs on the wire thread; the engine methods marshal their pool/
+        cache work onto the engine thread internally."""
+        xfer = msg.get("xfer")
+        if msg.get("release"):
+            st = exports.pop(xfer, None)
+            if st is not None:
+                try:
+                    self.engine.export_chain_end(st["pages"])
+                except Exception as e:  # noqa: BLE001 — a release race
+                    # with engine stop is the peer's teardown, not ours
+                    log.warning("replica %d: export release failed: %s",
+                                self.replica_id, e)
+            return
+        if not hasattr(self.engine, "export_chain_begin"):
+            outq.put({"op": "page_push", "xfer": xfer,
+                      "error": "replica does not serve page migration"})
+            return
+        st = exports.get(xfer)
+        if st is None:
+            prompt = np.asarray(msg.get("prompt", ()), np.int32)
+            try:
+                pages, digests = self.engine.export_chain_begin(prompt)
+            except Exception as e:  # noqa: BLE001 — the peer gets the
+                # failure, the wire loop keeps serving
+                outq.put({"op": "page_push", "xfer": xfer,
+                          "error": str(e)})
+                return
+            st = exports[xfer] = {"pages": pages, "digests": digests,
+                                  "prompt": prompt}
+        lo = max(0, int(msg.get("lo", 0)))
+        n = max(0, int(msg.get("n", migrate.DEFAULT_WINDOW)))
+        chain_len = len(st["pages"])
+        hi = min(lo + n, chain_len)
+        try:
+            windows = (self.engine.export_chain_read(st["pages"], lo,
+                                                     hi - lo)
+                       if hi > lo else [])
+        except Exception as e:  # noqa: BLE001
+            outq.put({"op": "page_push", "xfer": xfer, "error": str(e)})
+            return
+        ps = int(getattr(self.engine, "page_size", 0) or 0)
+        for k, leaves in enumerate(windows):
+            d = lo + k
+            outq.put({
+                "op": "page_push", "xfer": xfer, "depth": d,
+                "digest": st["digests"][d],
+                "tokens": [int(t) for t in
+                           st["prompt"][d * ps:(d + 1) * ps]],
+                "payload": migrate.encode_page(leaves),
+                "chain_len": chain_len,
+            })
+        outq.put({"op": "page_push", "xfer": xfer, "end": True,
+                  "lo": lo, "sent": hi - lo, "chain_len": chain_len})
+
+    def _handle_migrate_in(self, msg: dict, outq) -> None:
+        """Pull a chain from a peer replica and import it (the decode-
+        replica side of a router-commanded re-homing)."""
+        xfer = msg.get("xfer")
+        if not hasattr(self.engine, "import_chain"):
+            outq.put({"op": "migrated", "xfer": xfer, "ok": False,
+                      "pages": 0,
+                      "error": "replica does not import pages"})
+            return
+        try:
+            stats = migrate.fetch_chain(
+                self.engine, msg["host"], int(msg["port"]),
+                np.asarray(msg.get("prompt", ()), np.int32))
+        except Exception as e:  # noqa: BLE001 — migration failure is
+            # an efficiency loss, never a correctness event: the
+            # router keeps routing this prefix wherever it lives
+            log.error("replica %d: migrate_in failed: %s",
+                      self.replica_id, e)
+            outq.put({"op": "migrated", "xfer": xfer, "ok": False,
+                      "pages": 0, "error": str(e)})
+            return
+        outq.put({"op": "migrated", "xfer": xfer, "ok": True, **stats})
 
     def _handle_submit(self, msg: dict, outq, dead: threading.Event,
                        handles: dict):

@@ -48,12 +48,31 @@ reading ``log7.log``:
       process re-announces (new port, same file) and the prober folds
       it back in.
 
+  disaggregation — ``prefill_replicas=P`` splits the tier into a
+      prefill pool (replicas 0..P-1) and a decode pool (the rest).
+      Cold prompts (affinity miss) route to the prefill pool; when a
+      prefill-pool replica finishes a request whose prompt has full
+      KV pages, the router RE-HOMES the chain: it commands the
+      least-loaded decode replica to pull the pages over the wire
+      (serve/migrate.py — ``migrate_in`` → ``page_fetch`` against the
+      prefill replica's own server socket) and, on the ``migrated``
+      ack, moves the prefix-owner entries so sibling traffic decodes
+      in the decode pool with near-zero prefill.  Migration failure
+      is an EFFICIENCY loss, never a correctness event: the chain
+      just stays where it is and the next miss re-prefills —
+      ``migration_failed`` is counted + flagged, no request is
+      touched.  ``prefill_replicas=0`` (default) is the colocated
+      tier, byte-identical to the pre-disaggregation router.
+
 Chaos composes (dtf_tpu/chaos): ``replica_kill@req:N`` SIGKILLs a
 replica at the Nth dispatch, ``net_partition@replica<K>:<ticks>``
 drops K's health probes for that many prober ticks (timeouts, not
 clean exits), ``slow_replica@replica<K>:<factor>`` stretches K's
-decode steps.  tools/router_smoke.py drives the matrix and pins
-token-exactness + zero lost requests (ci_check stage 9).
+decode steps, ``page_fetch_stall@replica<K>:<s>`` stalls K's
+migration client before each page-fetch window.  tools/
+router_smoke.py drives the matrix and pins token-exactness + zero
+lost requests (ci_check stage 9); tools/disagg_smoke.py pins the
+disaggregated tier token-exact against a colocated oracle.
 """
 
 from __future__ import annotations
@@ -281,6 +300,9 @@ class _Replica:
         self.hold_respawn = False
         self.reconnect_block = False
         self.version: str = ""
+        # disaggregation pool role: "both" (colocated default),
+        # "prefill" or "decode" when the router splits the tier
+        self.role: str = "both"
 
 
 class Router:
@@ -312,6 +334,7 @@ class Router:
         "_shadows": "_mu", "_shadow_by_req": "_mu", "_mirror": "_mu",
         "_mirror_acc": "_mu", "_stats_events": "_mu",
         "_draining": "_mu", "_ewma_latency": "_mu",
+        "_migrations": "_mu",
     }
 
     def __init__(self, num_replicas: int, rendezvous_dir: str, *,
@@ -331,12 +354,26 @@ class Router:
                  hedge_s: float = 0.0,
                  kill_hook: Optional[Callable] = None,
                  checkpoint_map: Optional[Dict[int, str]] = None,
+                 prefill_replicas: int = 0,
+                 migrate_timeout_s: float = 60.0,
                  seed: int = 0):
         if num_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {num_replicas}")
         if placement not in PLACEMENTS:
             raise ValueError(f"unknown placement {placement!r}; choose "
                              f"from {PLACEMENTS}")
+        prefill_replicas = int(prefill_replicas)
+        if prefill_replicas < 0 or prefill_replicas >= num_replicas:
+            if prefill_replicas != 0:
+                raise ValueError(
+                    f"prefill_replicas ({prefill_replicas}) must leave "
+                    f"at least one decode replica (num_replicas="
+                    f"{num_replicas})")
+        if prefill_replicas and placement != "affinity":
+            raise ValueError(
+                "disaggregation (prefill_replicas > 0) needs "
+                "placement='affinity' — pool re-homing rides the "
+                "prefix-owner map")
         if probe_interval_s >= health_timeout_s:
             raise ValueError(
                 f"probe_interval_s ({probe_interval_s}) must be < "
@@ -364,6 +401,15 @@ class Router:
         self._mu = threading.Condition()
         self._replicas = [_Replica(i, self.rendezvous_dir)
                           for i in range(int(num_replicas))]
+        self.prefill_replicas = prefill_replicas
+        self.migrate_timeout_s = float(migrate_timeout_s)
+        if prefill_replicas:
+            for r in self._replicas:
+                r.role = ("prefill" if r.id < prefill_replicas
+                          else "decode")
+        # in-flight chain migrations: xfer id -> bookkeeping (digests
+        # to re-home, source/target ids, start time, trace id)
+        self._migrations: Dict[str, dict] = {}
         self._queue: List[_Request] = []
         self._live: Dict[int, _Request] = {}
         self._outstanding = 0
@@ -451,6 +497,13 @@ class Router:
         # capacity simulator's queueing model calibrates against
         # (serve_stream_lag_s's missing sibling)
         self._m_queue_wait = m.histogram("router_queue_wait_s", unit="s")
+        # disaggregation: chains re-homed prefill pool -> decode pool
+        # over the wire, and the migrations that didn't make it (an
+        # efficiency loss, never a lost request)
+        self._m_migrations = m.counter("router_migrations_total",
+                                       unit="chains")
+        self._m_mig_failed = m.counter("router_migration_failed_total",
+                                       unit="chains")
         self._m_health = [m.gauge(f"router_replica{i}_healthy",
                                   unit="bool")
                           for i in range(int(num_replicas))]
@@ -647,6 +700,15 @@ class Router:
                         self._m_affinity_hit.inc()
                         return rep
             self._m_affinity_miss.inc()
+            if self.prefill_replicas:
+                # disaggregation: a COLD paged prompt is prefill work —
+                # keep it in the prefill pool (the chain re-homes to
+                # the decode pool once prefill completes).  Fallback
+                # to the full eligible set when the pool is out:
+                # availability beats pool purity.
+                pool = [r for r in eligible if r.role != "decode"]
+                if pool:
+                    eligible = pool
         return min(eligible, key=lambda r: (len(r.inflight), r.id))
 
     # -- dispatcher ----------------------------------------------------
@@ -680,6 +742,12 @@ class Router:
         for sh in [s for s in self._shadows.values()
                    if now - s.created > s.req.deadline_s]:
             self._drop_shadow_locked(sh, "shadow_timeout")
+        # migrations that never acked: a wedged transfer must not pin
+        # its bookkeeping (or block this chain's next migration) forever
+        for xfer in [x for x, m in self._migrations.items()
+                     if now - m["t0"] > self.migrate_timeout_s]:
+            self._fail_migration_locked(
+                xfer, self._migrations.pop(xfer), "timeout")
         for req in list(self._live.values()):
             if req.done or now <= req.deadline:
                 continue
@@ -963,6 +1031,14 @@ class Router:
                     rep.last_stats[tag] = msg
                     ev.set()
             return
+        if op == "migrated":
+            with self._mu:
+                self._finish_migration_locked(
+                    str(msg.get("xfer", "")), rep,
+                    ok=bool(msg.get("ok")),
+                    pages=int(msg.get("pages", 0)),
+                    error=msg.get("error"))
+            return
         with self._mu:
             wire_id = msg.get("id")
             sh = self._shadows.get(wire_id)
@@ -1032,6 +1108,12 @@ class Router:
                 if csh is not None:
                     csh.primary = tokens
                     self._compare_shadow_locked(csh)
+                # disaggregation: a prefill-pool replica finished a
+                # paged prompt — re-home its KV chain to the decode
+                # pool so sibling traffic decodes there prefill-free
+                if (self.prefill_replicas and rep.role == "prefill"
+                        and req.digests):
+                    self._maybe_migrate_locked(req, rep)
                 rep.completed += 1
                 finish = time.time()
                 latency = finish - req.submit_time
@@ -1086,6 +1168,85 @@ class Router:
         if req not in self._queue:
             self._queue.append(req)
         self._mu.notify_all()
+
+    # -- chain migration (disaggregation's re-home path) ----------------
+    def _maybe_migrate_locked(self, req: _Request,
+                              source: _Replica) -> None:
+        """Command a decode replica to PULL ``req``'s KV-page chain
+        from ``source`` (a prefill-pool replica that just completed
+        it).  Skips quietly when the chain is already decode-homed,
+        already in flight, or no decode replica can take it — the
+        colocated fallback is always correct, just warmer-pool-less."""
+        deepest = req.digests[-1]
+        owner = self._prefix_owner.get(deepest)
+        if (owner is not None
+                and self._replicas[owner].role == "decode"):
+            return
+        if any(m["digests"] and m["digests"][-1] == deepest
+               for m in self._migrations.values()):
+            return   # this chain is already migrating
+        targets = [r for r in self._replicas
+                   if r.role == "decode" and r.healthy
+                   and not r.gave_up and not r.draining
+                   and not r.shadow_only and r.wfile is not None
+                   and (req.version is None or r.version == req.version)]
+        if not targets:
+            return
+        target = min(targets, key=lambda r: (len(r.inflight), r.id))
+        xfer = f"m{req.id}.{source.id}.{target.id}"
+        try:
+            send_msg(target.wfile, target.wlock,
+                     {"op": "migrate_in", "xfer": xfer,
+                      "host": source.host, "port": source.port,
+                      "prompt": [int(t) for t in req.prompt]})
+        except (OSError, ValueError):
+            return
+        self._migrations[xfer] = {
+            "digests": list(req.digests), "source": source.id,
+            "target": target.id, "t0": time.monotonic(),
+            "trace": req.trace}
+        trace.event("chain_migrate", request=req.id, trace=req.trace,
+                    xfer=xfer, source=source.id, target=target.id,
+                    pages=len(req.digests))
+
+    def _finish_migration_locked(self, xfer: str, rep: _Replica,
+                                 ok: bool, pages: int,
+                                 error=None) -> None:
+        mig = self._migrations.pop(xfer, None)
+        if mig is None or rep.id != mig["target"]:
+            self._m_stale.inc()
+            return
+        if ok:
+            # re-home the owner map: sibling traffic now finds its
+            # warm chain in the decode pool (insertion at tail keeps
+            # the bounded map's LRU-ish eviction honest)
+            for d in mig["digests"]:
+                self._prefix_owner.pop(d, None)
+                self._prefix_owner[d] = rep.id
+            self._m_migrations.inc()
+            trace.event("chain_migrated", xfer=xfer, trace=mig["trace"],
+                        source=mig["source"], target=rep.id,
+                        pages=pages)
+        else:
+            self._fail_migration_locked(xfer, mig,
+                                        str(error or "unknown"))
+
+    def _fail_migration_locked(self, xfer: str, mig: dict,
+                               error: str) -> None:
+        """A migration that didn't make it: counted + flagged, owner
+        map untouched (the chain is still warm at the source) — an
+        efficiency loss, never a correctness event."""
+        self._m_mig_failed.inc()
+        trace.anomaly("migration_failed", xfer=xfer, trace=mig["trace"],
+                      source=mig["source"], target=mig["target"],
+                      error=error)
+
+    def migration_stats(self) -> dict:
+        """The disagg smoke/bench's gate inputs."""
+        with self._mu:
+            return {"migrated": self._m_migrations.value,
+                    "failed": self._m_mig_failed.value,
+                    "pending": len(self._migrations)}
 
     def _resolve_locked(self, req: _Request, result=None,
                         exc=None) -> None:
@@ -1226,6 +1387,11 @@ class Router:
         for sh in [s for s in self._shadows.values()
                    if s.replica == rep.id]:
             self._drop_shadow_locked(sh, reason)
+        # migrations with a dead endpoint can never complete either
+        for xfer in [x for x, m in self._migrations.items()
+                     if rep.id in (m["source"], m["target"])]:
+            self._fail_migration_locked(
+                xfer, self._migrations.pop(xfer), f"replica_lost:{reason}")
         # prefix owner-map HANDOFF: this replica's chained-digest
         # entries re-home to the warmest sibling instead of going
         # affinity-cold — the group re-prefills ONCE there and stays
@@ -1584,6 +1750,22 @@ class Router:
                 rep.last_stats.pop(tag, None)
             return None
         return rep.last_stats.pop(tag, None)
+
+    def reset_replica_measurement(self, replica_id: int) -> bool:
+        """Zero a replica engine's decode-gap/peak measurement state
+        over the wire (fire-and-forget ``reset_measurement`` op).
+        Benches call this after warmup so compile stalls don't
+        masquerade as serving gaps in the replica's distributions."""
+        rep = self._replicas[replica_id]
+        with self._mu:
+            if rep.wfile is None:
+                return False
+            try:
+                send_msg(rep.wfile, rep.wlock,
+                         {"op": "reset_measurement"})
+            except (OSError, ValueError):
+                return False
+        return True
 
 
 def replica_spawner(cmd: List[str], rendezvous_dir: str,

@@ -294,6 +294,7 @@ def _zero3_trainer(num_devices, batch=12):
     return trainer, rt, state, (images, labels)
 
 
+@pytest.mark.slow  # reshard-resume is pinned e2e every CI by elastic_smoke (stage 15)
 def test_zero3_reshard_across_non_dividing_dp(eight_devices):
     """The reshard headline at the layout level: a canonical (stage-0)
     state from an nd=4 mesh re-slices onto nd=3 — a dp that divides

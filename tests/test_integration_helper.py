@@ -20,6 +20,7 @@ def tiny_specs(monkeypatch):
     monkeypatch.setitem(data_base._SPECS, "cifar10", TINY)
 
 
+@pytest.mark.slow  # the same synthetic path runs tier-1 via test_train_smoke
 def test_run_synthetic_smoke():
     """The reference's own smoke invocation shape:
     -train_steps 1 -batch_size 4 -use_synthetic_data true."""

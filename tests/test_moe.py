@@ -307,6 +307,7 @@ def test_ep_over_model_axis_matches_single_device(tiny_moe_registry):
     np.testing.assert_allclose(s1["loss"], s2["loss"], rtol=2e-3)
 
 
+@pytest.mark.slow  # tier-1 keeps top1_ep_training + ep_with_seq_parallel for EP coverage
 def test_ep_over_model_axis_with_drops_trains(tiny_moe_registry):
     """Model-axis EP with a real capacity limit (drops differ per rank)
     still trains and stays replica-consistent."""
@@ -316,6 +317,7 @@ def test_ep_over_model_axis_with_drops_trains(tiny_moe_registry):
     assert np.isfinite(stats["eval_loss"])
 
 
+@pytest.mark.slow  # scale twin of top1_ep_training (tier-1)
 def test_e16_on_dp4_trains(tiny_moe_registry):
     """VERDICT r1 #8 'done when': E=16 experts on dp=4 trains with the
     scatter dispatch (no [n, E, C] tensor)."""

@@ -478,6 +478,7 @@ def test_nan_guard_aborts_training_e2e(tmp_path, monkeypatch):
     assert trace_main([str(tmp_path), "--check"]) == 1
 
 
+@pytest.mark.slow  # negative twin of test_nan_guard_aborts_training_e2e (tier-1)
 def test_nan_guard_can_be_disabled(monkeypatch):
     from dtf_tpu.cli import runner as runner_mod
     from dtf_tpu.data import synthetic_input_fn as real_synth
@@ -575,6 +576,7 @@ def test_profiler_trace_event_surfaced_in_summary(tmp_path, capsys):
     assert "profiler trace: /tmp/xyz/traces" in capsys.readouterr().out
 
 
+@pytest.mark.slow  # routing variant of the tier-1 traced-run tests
 def test_profile_steps_routes_to_trace_dir(tmp_path):
     """--profile_steps with a trace dir writes the jax.profiler dump
     under the TRACE dir (not model_dir, where it buried checkpoints)
@@ -658,6 +660,7 @@ def test_ledger_mfu_crosschecked_against_cost_analysis(tmp_path,
     assert s["count"] == 1 and s["mfu"] == mfu_ledger
 
 
+@pytest.mark.slow  # near-twin of test_traced_smoke_train_reconciles_step_spans (tier-1)
 def test_traced_run_carries_run_trace_and_ledger(tmp_path, monkeypatch):
     """E2E: a traced smoke run's records all share ONE run-scoped
     trace id (steps, windows, train_end — so --request joins them),
@@ -692,6 +695,7 @@ def test_traced_run_carries_run_trace_and_ledger(tmp_path, monkeypatch):
     assert trace_main([str(tmp_path), "--ledger"]) == 0
 
 
+@pytest.mark.slow  # ledger contract itself stays tier-1 (mfu crosscheck test)
 def test_ledger_env_kill_switch(tmp_path, monkeypatch):
     monkeypatch.setenv("DTF_LEDGER", "0")
     run(base_cfg(train_steps=3, trace_dir=str(tmp_path)))

@@ -296,6 +296,7 @@ def test_pp_with_data_parallel(tiny_pipe_registry):
     assert np.isfinite(stats["loss"])
 
 
+@pytest.mark.slow  # remat-policy equivalence is pinned tier-1 at transformer + TP level
 def test_pp_remat_policy_matches_no_remat(tiny_pipe_registry):
     """--remat_policy dots on the pipeline family: same trajectory as
     the no-remat model, off-mesh and as 4 stages."""

@@ -30,7 +30,9 @@ import math
 import pytest
 
 from dtf_tpu.plan.serve_model import (FleetConfig, ServeProfile,
-                                      calibration_ratios, pool_vs_shed,
+                                      calibration_ratios,
+                                      measured_tp_comm_frac, pool_split,
+                                      pool_vs_shed,
                                       rank_tp_vs_replicas, ratios_within,
                                       replicas_for, simulate)
 from dtf_tpu.plan.serve_trace import (RequestRecord, Workload,
@@ -600,6 +602,129 @@ def test_cost_per_token_ranking():
 
 
 # ---------------------------------------------------------------------------
+# jitter + hedging (measured per-step spread in the simulator)
+# ---------------------------------------------------------------------------
+
+JITTER = (0.8, 0.9, 1.0, 1.0, 1.1, 1.5, 2.5)
+
+
+def test_profile_from_records_extracts_jitter():
+    durs = [0.010, 0.010, 0.011, 0.012, 0.009, 0.010, 0.013, 0.030]
+    recs = ([{"kind": "span", "name": "serve_decode", "ts": 0.0,
+              "dur_s": d} for d in durs]
+            + [{"kind": "span", "name": "serve_prefill_chunk",
+                "ts": 0.0, "dur_s": 0.008, "tokens": 64}])
+    p = ServeProfile.from_records(recs)
+    med = p.decode_step_s
+    assert p.jitter == tuple(sorted(round(d / med, 6) for d in durs))
+    assert p.jitter[-1] == pytest.approx(0.030 / med)   # tail survives
+    # fewer than the minimum span count: no jitter claimed
+    few = ServeProfile.from_records(recs[:3] + recs[-1:])
+    assert few.jitter == ()
+
+
+def test_jitter_validation_and_canonical_tuple():
+    with pytest.raises(ValueError, match="jitter"):
+        ServeProfile(decode_step_s=0.01, prefill_chunk_s=0.01,
+                     jitter=(1.0, -0.5))
+    p = ServeProfile(decode_step_s=0.01, prefill_chunk_s=0.01,
+                     jitter=[1.0, 1.2])        # JSON round-trip shape
+    assert p.jitter == (1.0, 1.2)
+
+
+def test_jitter_is_deterministic_and_changes_the_tail():
+    w = synthetic_workload(rate_rps=25, duration_s=10, seed=5)
+    jittered = dataclasses.replace(PROFILE, jitter=JITTER)
+    a = simulate(w, jittered, CONFIG)
+    assert a == simulate(w, jittered, CONFIG)
+    det = simulate(w, PROFILE, CONFIG)
+    # the measured spread must actually reach the prediction
+    assert a.latency_p99_s != det.latency_p99_s
+
+
+def test_hedge_reroutes_stragglers_only_under_jitter():
+    w = synthetic_workload(rate_rps=20, duration_s=20, seed=0,
+                           process="burst", burst_factor=4.0,
+                           prompt_tokens=(64, 256), decode_tokens=32)
+    cfg = dataclasses.replace(CONFIG, replicas=2, pool_pages=128,
+                              hedge_s=0.2)
+    jittered = dataclasses.replace(PROFILE, jitter=JITTER)
+    hedged = simulate(w, jittered, cfg)
+    assert hedged.hedged > 0
+    # same spread, no hedge bar: nothing moves
+    assert simulate(w, jittered,
+                    dataclasses.replace(cfg, hedge_s=0.0)).hedged == 0
+    # hedge bar without measured jitter: deterministic service never
+    # straggles, the knob stays a recorded no-op
+    assert simulate(w, PROFILE, cfg).hedged == 0
+
+
+# ---------------------------------------------------------------------------
+# pool_split (disaggregated prefill/decode what-if)
+# ---------------------------------------------------------------------------
+
+def test_pool_split_rows_shape_and_wire_cost_pinned():
+    w = synthetic_workload(rate_rps=30, duration_s=10, seed=0,
+                           prompt_tokens=(64, 256), decode_tokens=32)
+    cfg = dataclasses.replace(CONFIG, pool_pages=128)
+    best, rows = pool_split(w, PROFILE, cfg, 4, page_bytes=1 << 18,
+                            wire_gbps=20.0, wire_latency_s=0.001)
+    assert [r.prefill_replicas for r in rows] == [0, 1, 2, 3]
+    assert [r.decode_replicas for r in rows] == [4, 3, 2, 1]
+    colo = rows[0]
+    assert colo.is_colocated and colo.prefill is None
+    assert colo.migrate_chunk_s == 0.0
+    # one chunk = chunk_tokens/page_size pages over the wire + window
+    want = 0.001 + (64 / 16) * (1 << 18) / (20.0 * 1e9 / 8.0)
+    assert rows[1].migrate_chunk_s == pytest.approx(want)
+    for row in rows[1:]:
+        assert row.prefill is not None
+        assert row.loss_rate >= row.decode.loss_rate
+        assert "p:" in row.describe()
+    d = rows[1].to_dict()
+    assert d["prefill"]["completed"] == len(w.requests)
+    # a fast wire at this load: some split beats colocated p99
+    assert best is not None and not best.is_colocated
+    assert best.decode.latency_p99_s < colo.decode.latency_p99_s
+
+
+def test_pool_split_slow_wire_colocated_wins():
+    w = synthetic_workload(rate_rps=30, duration_s=10, seed=0,
+                           prompt_tokens=(64, 256), decode_tokens=32)
+    cfg = dataclasses.replace(CONFIG, pool_pages=128)
+    best, rows = pool_split(w, PROFILE, cfg, 4, page_bytes=1 << 20,
+                            wire_gbps=0.01, wire_latency_s=0.05)
+    assert best is None          # migration cost eats the split's win
+    assert len(rows) == 4        # the rows still document why
+
+
+def test_pool_split_validation():
+    w = _workload([_req(0, 0.0)])
+    with pytest.raises(ValueError, match="chips"):
+        pool_split(w, PROFILE, CONFIG, 1)
+    with pytest.raises(ValueError, match="multiple"):
+        pool_split(w, PROFILE,
+                   dataclasses.replace(CONFIG, tp=2), 5)
+    with pytest.raises(ValueError, match="wire_gbps"):
+        pool_split(w, PROFILE, CONFIG, 4, wire_gbps=0.0)
+
+
+def test_measured_tp_comm_frac_solves_and_clamps():
+    # t(2) = t(1)·(f + (1−f)/2): f=0.2 → 6 ms from a 10 ms base
+    assert measured_tp_comm_frac(0.010, 0.006) == pytest.approx(0.2)
+    # perfect halving = all compute; slowdown clamps pessimistic
+    assert measured_tp_comm_frac(0.010, 0.005) == 0.0
+    assert measured_tp_comm_frac(0.010, 0.012) == 0.95
+    # tp_base generalization: 2→4 chips
+    assert measured_tp_comm_frac(0.010, 0.007, tp_base=2,
+                                 tp_scaled=4) == pytest.approx(0.4)
+    with pytest.raises(ValueError):
+        measured_tp_comm_frac(0.0, 0.01)
+    with pytest.raises(ValueError):
+        measured_tp_comm_frac(0.01, 0.01, tp_base=2, tp_scaled=2)
+
+
+# ---------------------------------------------------------------------------
 # calibration
 # ---------------------------------------------------------------------------
 
@@ -702,3 +827,60 @@ def test_cli_trace_mode(tmp_path):
 def test_cli_empty_trace_dir_is_loud(tmp_path):
     from dtf_tpu.cli.plan_serve_main import main as plan_serve_main
     assert plan_serve_main(["--trace", str(tmp_path)]) == 2
+
+
+def test_cli_pool_split_whatif(tmp_path, capsys):
+    from dtf_tpu.cli.plan_serve_main import main as plan_serve_main
+    out = tmp_path / "art.json"
+    rc = plan_serve_main([
+        "--rate", "30", "--duration", "10", "--decode_step_ms", "10",
+        "--prefill_chunk_ms", "12", "--chunk_tokens", "64",
+        "--prompt_tokens", "64:256", "--decode_tokens", "32",
+        "--pool_pages", "128", "--chips", "4", "--pool_split",
+        "--migrate_page_bytes", str(1 << 18), "--migrate_wire_gbps",
+        "20", "--migrate_latency_ms", "1", "--out", str(out)])
+    assert rc == 0
+    art = json.loads(out.read_text())
+    rows = art["pool_split"]["rows"]
+    assert [r["prefill_replicas"] for r in rows] == [0, 1, 2, 3]
+    assert rows[0]["prefill"] is None
+    assert art["pool_split"]["answer"] is not None
+    assert "what-if: prefill:decode split" in capsys.readouterr().out
+
+
+def test_cli_pool_split_needs_chips(capsys):
+    from dtf_tpu.cli.plan_serve_main import main as plan_serve_main
+    with pytest.raises(SystemExit, match="chips"):
+        plan_serve_main(["--rate", "5", "--duration", "5",
+                         "--decode_step_ms", "10",
+                         "--prefill_chunk_ms", "8", "--pool_split"])
+
+
+@pytest.mark.slow
+def test_cli_measure_tp_comm_live(tmp_path):
+    """Two live traced bursts (tp=1 vs tp=2 over virtual host devices)
+    solve the Amdahl split; the gauge lands in the default registry and
+    the measured value replaces the documented default."""
+    from dtf_tpu.cli.plan_serve_main import main as plan_serve_main
+    from dtf_tpu.obs import trace
+    from dtf_tpu.obs.registry import default_registry
+
+    out = tmp_path / "art.json"
+    try:
+        rc = plan_serve_main([
+            "--measure_tp_comm", "--calibrate_requests", "6",
+            "--calibrate_budget", "12", "--seq", "64",
+            "--decode_step_ms", "10", "--prefill_chunk_ms", "8",
+            "--rate", "10", "--duration", "5", "--out", str(out)])
+    finally:
+        trace.disable()
+    assert rc == 0
+    art = json.loads(out.read_text())
+    meas = art["tp_comm_measurement"]
+    assert 0.0 <= meas["tp_comm_frac"] <= 0.95
+    assert meas["decode_step_s_tp1"] > 0
+    assert meas["decode_step_s_tp2"] > 0
+    # the what-ifs in the same run used the measured value
+    assert art["profile"]["tp_comm_frac"] == meas["tp_comm_frac"]
+    g = default_registry().get("plan_serve_tp_comm_frac")
+    assert g is not None and g.value == meas["tp_comm_frac"]

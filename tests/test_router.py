@@ -842,6 +842,60 @@ def test_version_affinity_pins_failover_to_same_model(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# disaggregation (pool roles + chain migration orchestration)
+# ---------------------------------------------------------------------------
+
+def test_disagg_validation():
+    with pytest.raises(ValueError, match="decode replica"):
+        Router(2, "/tmp/x", prefill_replicas=2)
+    with pytest.raises(ValueError, match="affinity"):
+        Router(3, "/tmp/x", prefill_replicas=1,
+               placement="least_loaded")
+
+
+def test_disagg_cold_prompts_route_to_prefill_pool(tmp_path):
+    """With a 1+1 split, cold paged prompts land in the prefill pool
+    even when the decode replica is less loaded."""
+    router, reps = make_tier(tmp_path, 2,
+                             router_kw=dict(prefill_replicas=1))
+    try:
+        for salt in range(3):
+            p = (np.arange(1, 17, dtype=np.int32) + 11 * salt) % 97
+            r = router.generate(p, max_new_tokens=4)
+            assert r.tokens == oracle(p, 4)
+            assert r.replica == 0, (
+                "cold paged prompt left the prefill pool")
+    finally:
+        stop_tier(router, reps)
+
+
+def test_disagg_migration_failure_never_loses_a_request(tmp_path):
+    """FakeEngine has no migration surface, so every migrate_in is
+    refused (ok=false) — the router must count the failure and keep
+    serving token-exactly: migration failure is an efficiency loss,
+    never a correctness event."""
+    router, reps = make_tier(tmp_path, 2,
+                             router_kw=dict(prefill_replicas=1))
+    try:
+        p = np.arange(1, 17, dtype=np.int32)     # 2 full pages @ ps=8
+        r1 = router.generate(p, max_new_tokens=6)
+        assert r1.tokens == oracle(p, 6) and r1.replica == 0
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            ms = router.migration_stats()
+            if ms["failed"]:
+                break
+            time.sleep(0.05)
+        assert ms["failed"] >= 1 and ms["migrated"] == 0
+        assert ms["pending"] == 0
+        # the chain stays affinity-homed at the source; traffic flows
+        r2 = router.generate(p, max_new_tokens=6)
+        assert r2.tokens == oracle(p, 6) and r2.replica == 0
+    finally:
+        stop_tier(router, reps)
+
+
+# ---------------------------------------------------------------------------
 # the real-subprocess matrix (the ci_check stage-9 contract)
 # ---------------------------------------------------------------------------
 

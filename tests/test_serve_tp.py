@@ -105,6 +105,7 @@ def test_tp2_token_exact_vs_single_device(model_and_params, eight_devices,
     assert got == ref
 
 
+@pytest.mark.slow  # scale twin of the tier-1 tp2 token-exact parametrization
 def test_tp4_token_exact_vs_single_device(model_and_params, eight_devices):
     """The axis generalizes: TP=4 (every head on its own shard pair)
     is exact too."""
@@ -202,6 +203,7 @@ def test_tp_restore_train_checkpoint_token_exact(tmp_path,
     _assert_exact_at_batches(model, variables["params"], params, mesh)
 
 
+@pytest.mark.slow  # restore coverage stays tier-1 via the train-checkpoint twin
 def test_tp_restore_export_format_token_exact(tmp_path, model_and_params,
                                               eight_devices):
     """The --export_dir inference artifact restores sharded too."""

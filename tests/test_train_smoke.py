@@ -75,6 +75,7 @@ def test_mirrored_8_devices():
     check_stats(run(base_cfg(distribution_strategy="mirrored")))
 
 
+@pytest.mark.slow  # alias of the mirrored strategy path (tier-1)
 def test_tpu_strategy_alias():
     check_stats(run(base_cfg(distribution_strategy="tpu")))
 
@@ -84,6 +85,7 @@ def test_horovod_parity_mode():
     check_stats(run(base_cfg(distribution_strategy="horovod")))
 
 
+@pytest.mark.slow  # PS coverage stays tier-1 via test_ps.py
 def test_parameter_server_spmd_mode():
     check_stats(run(base_cfg(distribution_strategy="parameter_server")))
 

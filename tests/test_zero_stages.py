@@ -273,6 +273,7 @@ def test_zero_wire_validation():
 ZERO_WIRE_LOSS_RTOL = 5e-2
 
 
+@pytest.mark.slow  # long tolerance run; bf16-wire validation units stay tier-1
 def test_zero_wire_bf16_tracks_f32_within_tolerance(eight_devices):
     """--zero_wire bf16 halves the stage-2/3 scatter volume by casting
     the padded flat grads to bf16 BEFORE psum_scatter (the slices and

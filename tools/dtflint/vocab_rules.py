@@ -47,6 +47,7 @@ CHAOS_PROBES = {
     "rollout_kill": "rollout_kill",
     "device_loss": "step",
     "host_loss": "step",
+    "page_fetch_stall": "page_fetch_stall",
 }
 
 _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
