@@ -673,6 +673,102 @@ def router_disagg_bar(tmpdir, rounds=6):
     return bars
 
 
+def _freeze_router(router):
+    """What a SIGKILL leaves behind, in-process (the smoke's freeze):
+    loops stopped, TCP severed mid-stream, nothing resolved."""
+    import socket as socket_mod
+    with router._mu:
+        router._stopping = True
+        router._mu.notify_all()
+    for rep in router._replicas:
+        conn = rep.conn
+        if conn is not None:
+            try:
+                conn.shutdown(socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+        router._close_conn(rep)
+
+
+def router_takeover_bar(tmpdir, replicas, samples=4):
+    """Time-to-takeover: the leader dies mid-burst, a standby waits out
+    the fenced lease, adopts the live tier and replays the journal.
+    Bar: p99 (max over samples) bounded, zero lost requests — an HA
+    story whose takeover stalls or sheds is downtime with extra steps."""
+    from dtf_tpu.serve import ha
+    from dtf_tpu.serve import journal as journal_mod
+    from dtf_tpu.serve.router import Router, replica_spawner
+    bars = []
+    lease_ttl = 0.5
+    workdir = os.path.join(tmpdir, "takeover")
+    rdv = os.path.join(workdir, "rdv")
+    cmd = [sys.executable, "-m", "dtf_tpu.cli.replica_main",
+           "--rendezvous_dir", rdv, *ROUTER_REPLICA_FLAGS]
+
+    def make_router(epoch, spawn=None):
+        r = Router(replicas, rdv, spawn=spawn, page_size=16,
+                   probe_interval_s=0.25, health_timeout_s=5.0,
+                   deadline_s=120.0, replica_inflight=4, seed=3,
+                   journal_path=journal_mod.journal_path(rdv),
+                   epoch=epoch)
+        r.start(wait_s=600 if spawn else 60, adopt=spawn is None)
+        return r
+
+    owner = make_router(1, spawn=replica_spawner(cmd, rdv))
+    routers = [owner]
+    times, lost = [], 0
+    rng = np.random.default_rng(29)
+    try:
+        router_burst(owner, 2, seed=40)     # warm the tier
+        leader, epoch = owner, 1
+        lease = ha.LeaderLease(rdv, ttl_s=lease_ttl, holder="bench-0")
+        lease.acquire()
+        for i in range(samples):
+            keeper = ha.LeaseKeeper(lease, on_fenced=leader.fence)
+            keeper.start()
+            handles = [leader.submit(
+                rng.integers(0, 256, (12,)).astype(np.int32),
+                max_new_tokens=48) for _ in range(6)]
+            time.sleep(0.3)                 # burst decoding in flight
+            keeper.stop()
+            _freeze_router(leader)
+            t0 = time.monotonic()
+            lease = ha.LeaderLease(rdv, ttl_s=lease_ttl,
+                                   holder=f"bench-{i + 1}")
+            epoch = ha.wait_for_takeover(lease, poll_s=0.05,
+                                         timeout_s=60.0)
+            leader = make_router(epoch)
+            summary = ha.take_over(leader, resume_rollout=False)
+            times.append(time.monotonic() - t0)
+            routers.append(leader)
+            for h in handles:
+                if h.done() and h._exc is None:
+                    continue                # resolved before the kill
+                nh = summary["handles"].get(h.request.id)
+                try:
+                    if nh is None:
+                        raise RuntimeError("not adopted")
+                    nh.result(timeout=150)
+                except Exception:
+                    lost += 1
+        p99 = max(times)
+        _jline("router_takeover_p99", p99, "s", model=ROUTER_MODEL,
+               samples=samples, lease_ttl_s=lease_ttl,
+               mean=round(sum(times) / len(times), 4),
+               lost_requests=lost)
+        if lost:
+            bars.append(f"takeover lost {lost} requests across "
+                        f"{samples} leader kills (bar: zero)")
+        if p99 >= 15.0:
+            bars.append(f"time-to-takeover p99 {p99:.2f}s breaches the "
+                        f"15s bound (lease ttl {lease_ttl}s)")
+    finally:
+        for r in routers[1:]:
+            r.stop(drain=False)
+        owner.stop(drain=False)   # owns the replica processes
+    return bars
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="transformer_small")
@@ -869,6 +965,7 @@ def main():
             failed += router_overload_bar(tier_dir, args.router_replicas)
             failed += router_affinity_bar(tier_dir, args.router_replicas)
             failed += router_disagg_bar(tier_dir)
+            failed += router_takeover_bar(tier_dir, args.router_replicas)
             clean = True
         finally:
             if clean and not failed:

@@ -101,6 +101,24 @@ Kinds and their firing semantics:
                           signature the disaggregated router's
                           migration timeout + local-prefill fallback
                           must absorb without losing a request.
+  router_kill@req:N       the serving ROUTER itself dies uncleanly
+                          (os._exit, no drain, no journal sync) as it
+                          performs its Nth dispatch (exact match,
+                          one-shot) — in-process tiers substitute the
+                          router's ``crash_hook``.  The HA standby
+                          (serve/ha.py) must take over: replay the
+                          request journal, fence the dead leader's
+                          epoch, and re-adopt every in-flight request
+                          exactly-once (zero lost, zero replica
+                          respawns).
+  lease_stall@T           the leader's lease RENEWALS are silently
+                          dropped for T consecutive renewal attempts
+                          (countdown, starts at the first renewal
+                          after arming) — the leader freezes without
+                          dying, its lease expires, the standby takes
+                          over, and the old leader must come back
+                          FENCED (stale-epoch rejected), not resume
+                          control.  The split-brain drill.
   rollout_kill@phase:P    the rollout controller (serve/rollout.py)
                           SIGKILLs a replica as the rollout works in
                           phase P ∈ {canary, rolling} (one-shot; an
@@ -140,7 +158,8 @@ EXIT_INJECTED_CRASH = 77   # injected hard crash (budgeted restart)
 
 KINDS = ("crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
          "reader_crash", "replica_kill", "net_partition", "slow_replica",
-         "rollout_kill", "device_loss", "host_loss", "page_fetch_stall")
+         "rollout_kill", "device_loss", "host_loss", "page_fetch_stall",
+         "router_kill", "lease_stall")
 _POINTS = {
     "crash": "step",
     "sigterm": "step",
@@ -155,13 +174,16 @@ _POINTS = {
     "slow_replica": "factor",
     "rollout_kill": "phase",
     "page_fetch_stall": "seconds",
+    "router_kill": "req",
+    "lease_stall": "ticks",
 }
 # rollout_kill's point value is a PHASE NAME, not a number
 ROLLOUT_PHASES = ("canary", "rolling")
 # distributed kinds whose point accepts the bare-value shorthand
 # (net_partition@replica1:6) and which require/allow a replica target
 _REPLICA_REQUIRED = ("net_partition", "slow_replica", "page_fetch_stall")
-_BARE_POINT = ("net_partition", "slow_replica", "page_fetch_stall")
+_BARE_POINT = ("net_partition", "slow_replica", "page_fetch_stall",
+               "lease_stall")
 # kinds whose point value is a float (everything else is an int)
 _FLOAT_POINT = ("slow_replica", "page_fetch_stall")
 
@@ -275,6 +297,11 @@ def parse_spec(text: str) -> List[FaultSpec]:
             if value < 1:
                 raise ValueError(
                     f"fault spec {tok!r}: partition needs >= 1 probe tick")
+        elif kind == "lease_stall":
+            if value < 1:
+                raise ValueError(
+                    f"fault spec {tok!r}: lease stall needs >= 1 "
+                    f"renewal tick")
         elif value < 0:
             raise ValueError(f"fault spec {tok!r}: value must be >= 0")
         out.append(FaultSpec(kind, rank, value, replica=replica))
@@ -293,6 +320,8 @@ class Injector:
         # net_partition bookkeeping: spec index -> remaining probe ticks
         # (None until the partition starts)
         self._partition_left: dict = {}
+        # lease_stall bookkeeping: spec index -> remaining renewal ticks
+        self._stall_left: dict = {}
 
     def _armed(self, kind: str):
         return [s for s in self.specs if s.kind == kind and not s.fired]
@@ -432,6 +461,38 @@ class Injector:
                 if left <= 0:
                     continue    # healed
                 self._partition_left[i] = left - 1
+                return True
+        return False
+
+    def router_kill(self, req_seq: int) -> bool:
+        """Router-side, one-shot, EXACT-match on the dispatch sequence
+        number: True when the router should die uncleanly at its
+        ``req_seq``-th dispatch (serve/ha.py's takeover drill)."""
+        with self._mu:
+            for spec in self._armed("router_kill"):
+                if int(req_seq) == spec.value:
+                    self._record(spec, req=int(req_seq))
+                    return True
+        return False
+
+    def lease_stall(self) -> bool:
+        """Leader-lease-side, called once per renewal attempt: True
+        while the renewal write should be silently dropped (the lease
+        ages toward expiry under the standby's nose).  Counts down
+        ``value`` renewal ticks from the first attempt, then heals —
+        by which time the lease has expired and the old leader must
+        discover it is FENCED, not resume."""
+        with self._mu:
+            for i, spec in enumerate(self.specs):
+                if spec.kind != "lease_stall":
+                    continue
+                left = self._stall_left.get(i)
+                if left is None:
+                    left = int(spec.value)
+                    self._record(spec, ticks=left)
+                if left <= 0:
+                    continue    # healed
+                self._stall_left[i] = left - 1
                 return True
         return False
 
@@ -579,6 +640,20 @@ def net_partition(replica: int, traffic_started: bool) -> bool:
     if inj is None:
         return False
     return inj.net_partition(replica, traffic_started)
+
+
+def router_kill(req_seq: int) -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.router_kill(req_seq)
+
+
+def lease_stall() -> bool:
+    inj = _injector
+    if inj is None:
+        return False
+    return inj.lease_stall()
 
 
 def rollout_kill(phase: str, candidate: int) -> Optional[int]:

@@ -18,6 +18,14 @@ Examples:
   python -m dtf_tpu.cli.router_main --model_dir /tmp/lm_run \
       --router_replicas 4 --fault replica_kill@replica0:req:6
 
+  # HA pair on shared storage: the leader journals + holds the lease,
+  # the standby takes over (fencing epoch +1, zero replica respawns)
+  # the moment the leader dies:
+  python -m dtf_tpu.cli.router_main --serve_random_init --router_ha \
+      --rendezvous_dir /shared/tier &
+  python -m dtf_tpu.cli.router_main --serve_random_init \
+      --router_standby --rendezvous_dir /shared/tier
+
 SIGTERM drains the tier: the router sheds new submits, waits out
 in-flight work, SIGTERMs the replicas (each drains + exits 0), then
 exits 0 itself.
@@ -83,6 +91,55 @@ def run_router(cfg, random_init: bool = False) -> dict:
     rendezvous = cfg.rendezvous_dir or tempfile.mkdtemp(
         prefix="dtf_router_")
     cfg = cfg.replace(rendezvous_dir=rendezvous)
+
+    # --- high availability (serve/ha.py + serve/journal.py) ---
+    # leader: take the lease, journal every request, renew at ttl/3.
+    # standby: wait out the leader's lease, then take over under the
+    # next fencing epoch, adopting (never respawning) the live tier.
+    ha_on = cfg.router_ha or cfg.router_standby
+    ha_mod = lease = keeper = None
+    journal_file = None
+    epoch = 0
+    if ha_on:
+        from dtf_tpu.serve import ha as ha_mod
+        from dtf_tpu.serve import journal as journal_mod
+        journal_file = journal_mod.journal_path(rendezvous)
+        lease = ha_mod.LeaderLease(rendezvous,
+                                   ttl_s=cfg.router_lease_ttl_s)
+
+    # /healthz must answer DURING the standby's wait (external probes
+    # watch the takeover through it), so the payload source is swapped
+    # once the router exists
+    health_box = {"fn": lambda: {"ok": True, "role": "starting"}}
+    metrics_server = None
+    router_box: dict = {}
+    if cfg.metrics_port:
+        from dtf_tpu.obs.prom import MetricsServer
+        from dtf_tpu.obs.registry import default_registry
+        metrics_server = MetricsServer(
+            cfg.metrics_port,
+            registry_fn=lambda: (router_box["r"].metrics
+                                 if "r" in router_box
+                                 else default_registry()),
+            health_fn=lambda: health_box["fn"]())
+
+    if cfg.router_standby:
+        health_box["fn"] = lambda: ha_mod.standby_health(lease)
+        log.warning("standby: watching leader lease (ttl %.1fs) under "
+                    "%s", cfg.router_lease_ttl_s, rendezvous)
+        epoch = ha_mod.wait_for_takeover(lease)
+        log.warning("standby: lease expired — taking over at epoch %d",
+                    epoch)
+    elif ha_on:
+        epoch = lease.acquire()
+        if epoch is None:
+            if metrics_server is not None:
+                metrics_server.shutdown()
+            raise RuntimeError(
+                "leader lease already held — start this router with "
+                "--router_standby (or remove the stale "
+                "router_lease.json)")
+
     env_extra = {}
     if cfg.trace_dir:
         env_extra["DTF_TRACE_DIR"] = os.path.abspath(cfg.trace_dir)
@@ -100,13 +157,23 @@ def run_router(cfg, random_init: bool = False) -> dict:
     # the router (the rollout controller writes it) and the spawner
     # (reads it at spawn time → DTF_SERVE_CHECKPOINT)
     ckpt_map: dict = {}
-    spawn = replica_spawner(replica_command(cfg, random_init),
-                            rendezvous, env_extra=env_extra,
-                            extra_flags=extra_flags,
-                            checkpoint_map=ckpt_map)
+    # the standby never owns replica processes: the (dead) leader
+    # spawned them, and a takeover that respawned the tier would turn
+    # a router blip into N cold-starts
+    spawn = None
+    if not cfg.router_standby:
+        spawn = replica_spawner(replica_command(cfg, random_init),
+                                rendezvous, env_extra=env_extra,
+                                extra_flags=extra_flags,
+                                checkpoint_map=ckpt_map)
     router = Router(
         cfg.router_replicas, rendezvous, spawn=spawn,
         checkpoint_map=ckpt_map,
+        journal_path=journal_file,
+        journal_fsync_s=cfg.router_journal_fsync_s,
+        epoch=epoch or 0,
+        role="leader",   # by construction: it holds the lease (HA) or
+                         # is the only router (HA off)
         page_size=cfg.kv_page_size or 16,
         placement=cfg.router_placement,
         deadline_s=cfg.router_deadline_s,
@@ -131,26 +198,44 @@ def run_router(cfg, random_init: bool = False) -> dict:
     except ValueError:
         pass
 
-    metrics_server = None
-    if cfg.metrics_port:
-        from dtf_tpu.obs.prom import MetricsServer
-        metrics_server = MetricsServer(
-            cfg.metrics_port, registry_fn=lambda: router.metrics,
-            health_fn=router.health)
+    router_box["r"] = router
+    health_box["fn"] = router.health
+    if ha_on:
+        # the renewal heartbeat: a lease lost (stall, partition,
+        # operator force-take) fences this router on the spot
+        keeper = ha_mod.LeaseKeeper(lease, on_fenced=router.fence)
+        keeper.start()
 
-    log.info("router: spawning %d replicas (rendezvous %s)",
+    log.info("router: %s %d replicas (rendezvous %s)",
+             "adopting" if cfg.router_standby else "spawning",
              cfg.router_replicas, rendezvous)
     # first-compile on a CPU replica can take minutes; the wait only
     # ends early when every replica heartbeats + announces.  From here
     # on the tier must come down with us — a traffic-loop exception
     # must not leave N serve processes running
     try:
-        router.start(wait_s=600.0)
-        return _drive_traffic(cfg, router)
+        router.start(wait_s=600.0, adopt=cfg.router_standby)
+        adopt_summary = None
+        if cfg.router_standby:
+            adopt_summary = ha_mod.take_over(
+                router, rollout_state_path=cfg.rollout_state)
+            log.warning("standby: takeover complete — %s", {
+                k: v for k, v in adopt_summary.items()
+                if k != "handles"})
+        out = _drive_traffic(cfg, router)
+        if adopt_summary is not None:
+            out["takeover_epoch"] = router.epoch
+            out["readopted"] = adopt_summary["readopted"]
+            out["redispatched"] = adopt_summary["redispatched"]
+        return out
     except BaseException:
         router.stop(drain=False)
         raise
     finally:
+        if keeper is not None:
+            keeper.stop()
+        if lease is not None:
+            lease.release()
         if metrics_server is not None:
             metrics_server.shutdown()
 

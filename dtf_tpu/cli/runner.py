@@ -163,6 +163,18 @@ def run(cfg: Config) -> dict:
                     "written; exiting %d", p.step, preemption.EXIT_PREEMPTED)
         trace.flush()
         raise SystemExit(preemption.EXIT_PREEMPTED)
+    except Exception as e:  # noqa: BLE001 — device-loss classification
+        from dtf_tpu.train import elastic
+        if not (isinstance(e, elastic.DeviceLost)
+                or elastic.is_device_loss(e)):
+            raise
+        step = getattr(e, "step", -1)
+        log.warning("accelerators lost at step %d (%s) — exiting %d so "
+                    "an --elastic supervisor reshards onto the "
+                    "surviving topology", step, e,
+                    elastic.EXIT_DEVICE_LOST)
+        trace.flush()
+        raise SystemExit(elastic.EXIT_DEVICE_LOST)
     finally:
         if metrics_server is not None:
             metrics_server.shutdown()

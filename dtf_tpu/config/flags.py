@@ -359,6 +359,24 @@ class Config:
     # routable address makes the replica reachable from a router on
     # another host
     serve_host: str = "127.0.0.1"
+    # --- router high availability (serve/journal.py + serve/ha.py) ---
+    # journal every request's lifecycle to router_journal.jsonl in the
+    # rendezvous dir and take the shared-storage leader lease: a
+    # successor router (restart or warm standby) replays the journal
+    # and re-adopts in-flight requests exactly-once.  Off by default —
+    # a single-router tier pays zero overhead.
+    router_ha: bool = False
+    # run router_main as the WARM STANDBY: wait for the leader's lease
+    # to expire, then take over under the next fencing epoch (implies
+    # router_ha; never spawns replicas — the leader owns them)
+    router_standby: bool = False
+    # leader-lease time-to-live: the standby takes over after the
+    # leader misses ~1 TTL of renewals (renewal cadence is TTL/3)
+    router_lease_ttl_s: float = 2.0
+    # bounded journal fsync cadence: a HOST crash loses at most this
+    # much journal tail (a process crash loses nothing — every append
+    # is flushed)
+    router_journal_fsync_s: float = 0.05
 
     # --- zero-downtime rollout (serve/rollout.py over the router) ---
     # rollout the tier onto this checkpoint (a model_dir or
@@ -618,6 +636,20 @@ class Config:
             raise ValueError(
                 "serve_host must be a bindable address (127.0.0.1 for "
                 "single-host, a routable address for cross-host)")
+        if self.router_lease_ttl_s <= 0:
+            raise ValueError(
+                f"router_lease_ttl_s must be > 0, got "
+                f"{self.router_lease_ttl_s}")
+        if self.router_journal_fsync_s < 0:
+            raise ValueError(
+                f"router_journal_fsync_s must be >= 0, got "
+                f"{self.router_journal_fsync_s}")
+        if self.router_standby and not self.rendezvous_dir:
+            raise ValueError(
+                "router_standby needs an explicit --rendezvous_dir — "
+                "the standby finds the leader's lease, journal and "
+                "replicas there (a temp dir of its own would watch "
+                "an empty tier)")
         if self.rollout_canary_requests < 1:
             raise ValueError(
                 f"rollout_canary_requests must be >= 1, got "

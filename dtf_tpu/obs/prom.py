@@ -20,7 +20,11 @@ Scrape surface: ``GET /metrics`` (and ``/`` as an alias) plus
 ``GET /healthz`` — a JSON liveness probe for external health checkers
 (k8s-style): 200 ``{"ok": true, ...}`` while healthy, 503 when the
 optional ``health_fn`` reports ``ok: false`` (a draining replica, a
-router whose every replica is lost).  The registry is re-snapshotted
+router whose every replica is lost).  An HA router's payload also
+carries its posture — ``role`` (``leader``/``standby``), the fencing
+``epoch``, and ``fenced`` — so external probes can watch a standby
+takeover happen (Router.health / serve.ha.standby_health feed it
+through cli/router_main's health_fn).  The registry is re-snapshotted
 per request — the server holds a callable, not a frozen snapshot, so
 `MetricsRegistry.reset()` between runs in one process is reflected
 immediately; ``health_fn`` is likewise re-evaluated per probe.

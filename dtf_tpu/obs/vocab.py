@@ -39,6 +39,13 @@ KNOWN_ANOMALY_KINDS = (
     # detects torn transfers; serve/router.py flags migrations that
     # never made it — an efficiency loss, never a lost request)
     "migration_torn", "migration_failed",
+    # router HA (serve/ha.py + serve/replica.py): a replica rejecting
+    # a superseded controller's wire op, and the superseded router
+    # discovering it has been fenced off the tier
+    "stale_epoch", "router_fenced",
+    # train/loop.py step-site XLA failure classified as accelerator
+    # loss (train/elastic.py is_device_loss) — precedes EXIT_DEVICE_LOST
+    "device_lost",
 )
 
 #: event kinds of the run/request-timeline / ledger / profiler layer —
@@ -77,6 +84,10 @@ KNOWN_EVENT_KINDS = (
     "elastic_resume",
     # --profile_steps output-path marker (train/loop.py)
     "profiler_trace",
+    # router HA takeover (serve/ha.py): a successor assumed the tier
+    # under a new fencing epoch; per-request re-adoption confirmations
+    # (the replica still held the retained tail)
+    "router_takeover", "router_readopt",
 )
 
 #: raw chaos kinds — the ``fault_kind`` attr of ``injected_fault``
@@ -88,6 +99,7 @@ CHAOS_FAULT_KINDS = (
     "crash", "sigterm", "heartbeat_stall", "ps_drop", "ckpt_truncate",
     "reader_crash", "replica_kill", "net_partition", "slow_replica",
     "rollout_kill", "device_loss", "host_loss", "page_fetch_stall",
+    "router_kill", "lease_stall",
 )
 
 #: metric-name grammar: <subsystem>_<name>[_<unit-ish suffix>], where

@@ -42,6 +42,26 @@ speaks a newline-delimited-JSON wire protocol over TCP:
                                         router re-homing a finished
                                         chain onto a decode replica —
                                         serve/migrate.py)
+    {"op":"reattach","id":W}            a SUCCESSOR router re-adopting
+                                        request W across a router
+                                        death: the replica replays W's
+                                        retained token tail (i=0..)
+                                        and its done record on THIS
+                                        connection — the engine never
+                                        stopped decoding while the old
+                                        router's socket was down.
+                                        Unknown W → ``reattach_nack``
+                                        (the request died with this
+                                        replica; the router falls back
+                                        to ordinary budgeted failover)
+
+  Every CONTROLLER op may carry ``"epoch": E`` — the sender's fencing
+  epoch from the shared leader lease (serve/ha.py).  The replica
+  tracks the highest epoch it has seen and REJECTS ops from below it
+  with ``{"op":"stale_epoch",...}``: a deposed router that never
+  noticed losing the lease (GC pause, partition) is fenced out here,
+  at the only place split-brain could corrupt a client stream.  Ops
+  without an epoch (peer page_fetch, pre-HA routers) skip the check.
 
   peer replica (or router) → replica           KV-page migration
     {"op":"page_fetch","xfer":X,"prompt":[...],"lo":L,"n":N}
@@ -102,10 +122,16 @@ from typing import Optional
 
 import numpy as np
 
+from dtf_tpu.obs import trace
 from dtf_tpu.serve import migrate
 from dtf_tpu.serve.engine import Backpressure
 
 log = logging.getLogger("dtf_tpu")
+
+# retained per-request tails kept after their request finished: enough
+# for a takeover-window's worth of re-adoptions, bounded so a
+# long-lived replica's memory does not grow with total traffic
+RETAIN_DONE_CAP = 256
 
 
 def announce_path(rendezvous_dir: str, replica_id: int) -> str:
@@ -144,9 +170,15 @@ class ReplicaServer:
     per-connection thread's teardown, and ``stop()`` — guarded by
     ``_lock`` (declared below, enforced by tools/dtflint lock-guard):
     an unguarded ``list.remove`` racing another teardown throws
-    ValueError into the connection thread's finally block."""
+    ValueError into the connection thread's finally block.  The same
+    lock guards ``_retained`` (the per-request token tails a successor
+    router re-adopts — written by engine on_token callbacks, rebound
+    by ``reattach`` on a DIFFERENT connection's wire thread) and
+    ``_max_epoch`` (the fencing high-water mark every controller wire
+    thread checks)."""
 
-    _GUARDED_BY = {"_conns": "_lock"}
+    _GUARDED_BY = {"_conns": "_lock", "_retained": "_lock",
+                   "_max_epoch": "_lock"}
 
     def __init__(self, engine, replica_id: int, rendezvous_dir: str,
                  host: str = "127.0.0.1", port: int = 0,
@@ -170,6 +202,15 @@ class ReplicaServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
         self._conns: list = []
+        # wire id -> {"tokens": [...], "done": msg|None, "outq": q|None}
+        # — the request's retained tail.  Survives the CONNECTION (a
+        # router death must not lose tokens the engine keeps retiring);
+        # ``reattach`` rebinds "outq" to the successor's connection.
+        # Done entries are pruned beyond RETAIN_DONE_CAP, oldest first.
+        self._retained: dict = {}
+        # fencing epoch high-water mark (serve/ha.py): controller ops
+        # carrying an epoch below this are rejected as stale
+        self._max_epoch = 0
 
     # -- rendezvous ----------------------------------------------------
     def _announce(self) -> None:
@@ -292,8 +333,32 @@ class ReplicaServer:
                                 self.replica_id, line[:80])
                     continue
                 op = msg.get("op")
+                ep = msg.get("epoch")
+                if ep is not None:
+                    ep = int(ep)
+                    with self._lock:
+                        cur = self._max_epoch
+                        if ep >= cur:
+                            self._max_epoch = ep
+                    if ep < cur:
+                        # fenced controller: a deposed router that
+                        # never noticed losing the lease.  Reject the
+                        # op LOUDLY — obeying it is exactly the
+                        # split-brain a fencing epoch exists to stop.
+                        log.error("replica %d: rejecting stale-epoch "
+                                  "op %r (epoch %d < %d)",
+                                  self.replica_id, op, ep, cur)
+                        trace.anomaly("stale_epoch", op=op, epoch=ep,
+                                      current=cur,
+                                      wire_id=msg.get("id"))
+                        outq.put({"op": "stale_epoch",
+                                  "id": msg.get("id"), "epoch": ep,
+                                  "current": cur})
+                        continue
                 if op == "submit":
                     self._handle_submit(msg, outq, dead, handles)
+                elif op == "reattach":
+                    self._handle_reattach(msg, outq)
                 elif op == "cancel":
                     h = handles.pop(msg.get("id"), None)
                     if h is not None and hasattr(h, "cancel"):
@@ -341,6 +406,12 @@ class ReplicaServer:
             with self._lock:
                 if conn in self._conns:
                     self._conns.remove(conn)
+                # unbind this connection's queue from the retained
+                # tails: the engine keeps decoding (and retaining) —
+                # deliveries resume when a successor reattaches
+                for rec in self._retained.values():
+                    if rec["outq"] is outq:
+                        rec["outq"] = None
 
     def _stats(self) -> dict:
         out = {"op": "stats", "replica": self.replica_id,
@@ -450,17 +521,26 @@ class ReplicaServer:
     def _handle_submit(self, msg: dict, outq, dead: threading.Event,
                        handles: dict):
         wire_id = msg["id"]
-        counter = {"i": 0}
+        with self._lock:
+            # the request's retained tail: tokens append here FIRST
+            # (under the lock reattach replays under), then go to
+            # whatever connection the record is currently bound to —
+            # a router death loses the pipe, never the tokens
+            rec = self._retained[wire_id] = {
+                "tokens": [], "done": None, "outq": outq,
+                "ts": time.time()}
 
         def on_token(tok: int) -> None:
-            # engine thread: per-request tokens retire sequentially, so
-            # the unsynchronized counter is safe
-            if dead.is_set():
-                return
-            i = counter["i"]
-            counter["i"] = i + 1
-            outq.put({"op": "token", "id": wire_id, "token": int(tok),
-                      "i": i})
+            # engine thread: per-request tokens retire sequentially;
+            # the lock orders each append against any concurrent
+            # reattach replay, so indices never interleave on the wire
+            with self._lock:
+                rec["tokens"].append(int(tok))
+                i = len(rec["tokens"]) - 1
+                q = rec["outq"]
+            if q is not None and not (q is outq and dead.is_set()):
+                q.put({"op": "token", "id": wire_id, "token": int(tok),
+                       "i": i})
 
         try:
             handle = self.engine.submit(
@@ -481,11 +561,17 @@ class ReplicaServer:
                 # tokens (serve/decode.py position_key)
                 rng_seed=msg.get("rng_seed"))
         except Backpressure as bp:
+            # never admitted: nothing to retain — a successor must
+            # re-dispatch, not reattach to a shed request
+            with self._lock:
+                self._retained.pop(wire_id, None)
             outq.put({"op": "backpressure", "id": wire_id,
                       "retry_after": float(bp.retry_after)})
             return
         except Exception as e:  # noqa: BLE001 — a malformed request
             # must fail ITS caller, never the wire loop
+            with self._lock:
+                self._retained.pop(wire_id, None)
             outq.put({"op": "error", "id": wire_id, "error": str(e)})
             return
         handles[wire_id] = handle
@@ -495,14 +581,61 @@ class ReplicaServer:
                 r = handle.result(timeout=self.result_timeout_s)
             except Exception as e:  # noqa: BLE001
                 handles.pop(wire_id, None)
+                with self._lock:
+                    self._retained.pop(wire_id, None)
                 outq.put({"op": "error", "id": wire_id, "error": str(e)})
                 return
             handles.pop(wire_id, None)
-            outq.put({"op": "done", "id": wire_id,
-                      "tokens": [int(t) for t in r.tokens],
-                      "cancelled": bool(r.cancelled),
-                      "prompt_len": int(r.prompt_len),
-                      "latency_s": float(r.latency_s)})
+            done = {"op": "done", "id": wire_id,
+                    "tokens": [int(t) for t in r.tokens],
+                    "cancelled": bool(r.cancelled),
+                    "prompt_len": int(r.prompt_len),
+                    "latency_s": float(r.latency_s)}
+            with self._lock:
+                rec["done"] = done
+                q = rec["outq"]
+                self._prune_retained_locked()
+            if q is not None and not (q is outq and dead.is_set()):
+                q.put(done)
 
         threading.Thread(target=waiter, daemon=True,
                          name=f"replica{self.replica_id}-wait").start()
+
+    def _prune_retained_locked(self) -> None:
+        """Bound the retained-tail store: finished requests beyond
+        RETAIN_DONE_CAP drop, oldest first (unfinished ones are live
+        engine work and stay — they are the re-adoption payload)."""
+        done = [(rec["ts"], wid) for wid, rec in self._retained.items()
+                if rec["done"] is not None]
+        if len(done) <= RETAIN_DONE_CAP:
+            return
+        done.sort()
+        for _, wid in done[:len(done) - RETAIN_DONE_CAP]:
+            self._retained.pop(wid, None)
+
+    def _handle_reattach(self, msg: dict, outq) -> None:
+        """A successor router re-adopting one request (router HA,
+        serve/ha.py): rebind the retained record to THIS connection
+        and replay its buffered tail — ack, every token from i=0 (the
+        router's token-index dedupe verifies what its client already
+        has and emits only the rest), then the done record if the
+        engine already finished.  All under the lock on_token appends
+        under, so replayed and live indices never interleave."""
+        wire_id = msg.get("id")
+        with self._lock:
+            rec = self._retained.get(wire_id)
+            if rec is not None:
+                outq.put({"op": "reattached", "id": wire_id,
+                          "n": len(rec["tokens"]),
+                          "done": rec["done"] is not None})
+                for i, t in enumerate(rec["tokens"]):
+                    outq.put({"op": "token", "id": wire_id,
+                              "token": int(t), "i": i})
+                if rec["done"] is not None:
+                    outq.put(dict(rec["done"]))
+                rec["outq"] = outq
+        if rec is None:
+            # the request died WITH this replica (it was respawned, or
+            # never held it): the router falls back to ordinary
+            # budgeted failover re-dispatch
+            outq.put({"op": "reattach_nack", "id": wire_id})

@@ -64,6 +64,21 @@ reading ``log7.log``:
       touched.  ``prefill_replicas=0`` (default) is the colocated
       tier, byte-identical to the pre-disaggregation router.
 
+  high availability — the router itself is no longer a single point
+      of failure.  Every request's lifecycle is journaled to an
+      append-only WAL in the rendezvous dir (serve/journal.py) so a
+      SUCCESSOR router — a restart, or a warm standby holding the
+      shared-storage leader lease (serve/ha.py) — replays it and
+      RE-ADOPTS the in-flight requests: a new ``reattach`` wire op
+      rebinds each one to the replica still decoding it (engines never
+      stopped — a router death is an efficiency blip, not an outage)
+      and the replica replays its retained token tail through the SAME
+      token-index verify+dedupe that makes replica failover
+      exactly-once.  Split-brain is fenced by a monotonic epoch: every
+      controller wire op carries it, replicas reject ops from a
+      superseded epoch (``stale_epoch``), and a fenced router sheds
+      instead of double-driving the tier.
+
 Chaos composes (dtf_tpu/chaos): ``replica_kill@req:N`` SIGKILLs a
 replica at the Nth dispatch, ``net_partition@replica<K>:<ticks>``
 drops K's health probes for that many prober ticks (timeouts, not
@@ -72,7 +87,9 @@ decode steps, ``page_fetch_stall@replica<K>:<s>`` stalls K's
 migration client before each page-fetch window.  tools/
 router_smoke.py drives the matrix and pins token-exactness + zero
 lost requests (ci_check stage 9); tools/disagg_smoke.py pins the
-disaggregated tier token-exact against a colocated oracle.
+disaggregated tier token-exact against a colocated oracle;
+``router_kill@req:N`` + ``lease_stall@<ticks>`` drive the HA matrix
+(tools/router_ha_smoke.py, ci_check stage 17).
 """
 
 from __future__ import annotations
@@ -96,6 +113,7 @@ from dtf_tpu import chaos
 from dtf_tpu.obs import trace
 from dtf_tpu.obs.registry import MetricsRegistry
 from dtf_tpu.obs.watchdog import heartbeat_path, read_heartbeat
+from dtf_tpu.serve import journal as journal_mod
 from dtf_tpu.serve.engine import Backpressure, _page_digest
 from dtf_tpu.serve.replica import read_announce, send_msg
 
@@ -334,7 +352,7 @@ class Router:
         "_shadows": "_mu", "_shadow_by_req": "_mu", "_mirror": "_mu",
         "_mirror_acc": "_mu", "_stats_events": "_mu",
         "_draining": "_mu", "_ewma_latency": "_mu",
-        "_migrations": "_mu",
+        "_migrations": "_mu", "_fenced": "_mu", "_chain_heat": "_mu",
     }
 
     def __init__(self, num_replicas: int, rendezvous_dir: str, *,
@@ -356,7 +374,12 @@ class Router:
                  checkpoint_map: Optional[Dict[int, str]] = None,
                  prefill_replicas: int = 0,
                  migrate_timeout_s: float = 60.0,
-                 seed: int = 0):
+                 seed: int = 0,
+                 journal_path: Optional[str] = None,
+                 journal_fsync_s: float = 0.05,
+                 epoch: int = 0,
+                 role: str = "leader",
+                 crash_hook: Optional[Callable] = None):
         if num_replicas < 1:
             raise ValueError(f"need >= 1 replica, got {num_replicas}")
         if placement not in PLACEMENTS:
@@ -397,6 +420,13 @@ class Router:
         self.hedge_s = float(hedge_s)
         self._kill_hook = kill_hook
         self._rng = np.random.default_rng(seed)
+        # HA identity: the fencing epoch this router controls the tier
+        # under (0 = HA off / first leader), stamped on every
+        # controller wire op; role is reporting-only (health/healthz)
+        self.epoch = int(epoch)
+        self.role = str(role)
+        self._fenced = False
+        self._crash_hook = crash_hook
 
         self._mu = threading.Condition()
         self._replicas = [_Replica(i, self.rendezvous_dir)
@@ -438,6 +468,11 @@ class Router:
         self._mirror_acc = 0.0
         self._shadows: Dict[str, _Shadow] = {}
         self._shadow_by_req: Dict[int, _Shadow] = {}
+        # hot-chain tracker for the heal-time KV prefetch: deepest
+        # digest -> (dispatch count, full digest chain, prompt tokens).
+        # BOUNDED like the owner map — insertion-ordered, oldest out
+        self._chain_heat: Dict[str, tuple] = {}
+        self._chain_heat_cap = 64
 
         # obs registry: the router's operational vocabulary
         self.metrics = MetricsRegistry()
@@ -507,20 +542,55 @@ class Router:
         self._m_health = [m.gauge(f"router_replica{i}_healthy",
                                   unit="bool")
                           for i in range(int(num_replicas))]
+        # HA vocabulary: the fencing epoch this router drives the tier
+        # under, takeovers performed, requests recovered across a
+        # router death (re-attached to a live engine vs re-dispatched
+        # from scratch), stale-epoch rejections observed (any > 0 =
+        # a superseded controller tried to drive the tier), journal
+        # append→fsync lag (the bound on what a host crash can lose),
+        # and KV pages pulled by the heal-time prefetch
+        self._m_epoch = m.gauge("router_ha_epoch", unit="epoch")
+        self._m_epoch.set(self.epoch)
+        self._m_takeover = m.counter("router_takeover_total",
+                                     unit="takeovers")
+        self._m_readopted = m.counter("router_readopted_total",
+                                      unit="requests")
+        self._m_redispatched = m.counter("router_redispatched_total",
+                                         unit="requests")
+        self._m_stale_epoch = m.counter("router_stale_epoch_total",
+                                        unit="msgs")
+        self._m_jlag = m.histogram("router_journal_lag_s", unit="s")
+        self._m_prefetch = m.counter("router_prefetch_pages_total",
+                                     unit="pages")
+        # the crash-recovery WAL (None = HA off, zero overhead):
+        # created AFTER the metrics so fsync lag lands in the histogram
+        self._journal: Optional[journal_mod.RequestJournal] = None
+        if journal_path:
+            self._journal = journal_mod.RequestJournal(
+                journal_path, fsync_interval_s=journal_fsync_s,
+                lag_observe=self._m_jlag.observe)
 
         self._threads: List[threading.Thread] = []
         self._started = False
 
     # -- lifecycle -----------------------------------------------------
-    def start(self, wait_s: float = 0.0) -> "Router":
+    def start(self, wait_s: float = 0.0,
+              adopt: bool = False) -> "Router":
         """Spawn replicas (proc mode), start the dispatcher + prober.
         ``wait_s`` > 0 blocks until every replica is healthy (raises
         on timeout) — the smoke/bench posture; 0 returns immediately
-        and traffic queues until replicas register."""
+        and traffic queues until replicas register.
+
+        ``adopt=True`` is the TAKEOVER posture (serve/ha.py): the tier
+        is already running under a dead predecessor — do NOT unlink
+        its announce/heartbeat files or spawn fresh processes, just
+        discover the live replicas through the rendezvous and connect.
+        A takeover that respawned the tier would turn a router blip
+        into N replica cold-starts."""
         if self._started:
             raise RuntimeError("router already started")
         self._started = True
-        if self._spawn is not None:
+        if self._spawn is not None and not adopt:
             from dtf_tpu.serve.replica import announce_path
             for r in self._replicas:
                 # a heartbeat/announce surviving a previous run must not
@@ -589,6 +659,8 @@ class Router:
                 except subprocess.TimeoutExpired:
                     r.proc.kill()
                     r.proc.wait()
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self):
         return self
@@ -621,6 +693,12 @@ class Router:
         with self._mu:
             if self._stopping:
                 raise RuntimeError("router is stopped")
+            if self._fenced:
+                # a successor holds the lease: this router must not
+                # drive the tier (the replicas would reject it anyway)
+                raise RuntimeError(
+                    f"router fenced (epoch {self.epoch} superseded) — "
+                    f"submit to the current leader")
             if self._draining or self._outstanding >= self.admission_limit:
                 self._m_shed.inc()
                 retry = max(0.05, self._ewma_latency
@@ -648,6 +726,16 @@ class Router:
             self._live[req.id] = req
             self._outstanding += 1
             self._m_queue_depth.set(len(self._queue))
+            if self._journal is not None:
+                # journal AFTER admission (a shed is not recovery
+                # state) and BEFORE the handle is returned: once the
+                # client owns a handle, a successor can always finish
+                # the request
+                self._journal.submit(
+                    str(req.id), prompt=req.prompt,
+                    max_new_tokens=req.max_new_tokens,
+                    temperature=req.temperature, eos_id=req.eos_id,
+                    rng_seed=req.rng_seed, trace=req.trace)
             trace.event("router_submit", request=req.id, trace=req.trace,
                         span_id=req.span, prompt_len=int(prompt.size),
                         deadline_s=deadline_s,
@@ -657,6 +745,103 @@ class Router:
 
     def generate(self, prompt, **kw) -> RouterResult:
         return self.submit(prompt, **kw).result(timeout=600)
+
+    # -- takeover (serve/ha.py drives this) ----------------------------
+    def adopt_requests(self, state: dict,
+                       delivered: Optional[dict] = None) -> dict:
+        """Adopt a dead predecessor's unresolved requests — the
+        journal-replay half of a router takeover (``state`` is
+        ``journal.unresolved(journal.replay(...))``).
+
+        Each request is rebuilt BIT-IDENTICALLY from its journaled
+        submit record (prompt, budget, eos, and above all the minted
+        ``rng_seed`` — the sampling identity that makes any replay
+        token-exact) and, when its last journaled dispatch points at a
+        replica that is still up, RE-ATTACHED there: the ``reattach``
+        op makes the replica replay its retained token tail through
+        the ordinary verify+dedupe path, so the engine's uninterrupted
+        decode is simply picked back up.  A nack (the replica died
+        too, or never got it) falls through to ordinary budgeted
+        failover re-dispatch.
+
+        Exactly-once across the router death: ``delivered`` maps
+        request id -> the token list the CLIENT acknowledges having
+        received (the re-connecting client echoes it); those tokens
+        are verified, never re-emitted.  Without it, the journal's
+        delivery watermark seeds sentinel entries — a lower bound, so
+        at most ``watermark cadence - 1`` trailing tokens re-emit to a
+        client that can't say what it saw.
+
+        Deadlines restart at takeover (the journal records budgets,
+        not wall-clock promises).  Returns ``{"readopted",
+        "redispatched", "handles": {id: RouterHandle}}`` — the handles
+        are how re-connecting clients resume their streams."""
+        readopted = redispatched = 0
+        handles: Dict[int, RouterHandle] = {}
+        with self._mu:
+            self._m_takeover.inc()
+            for rid_key in sorted(state, key=lambda k: int(k)):
+                st = state[rid_key]
+                rid = int(rid_key)
+                if rid in self._live:
+                    handles[rid] = self._live[rid].handle
+                    continue   # idempotent: already adopted
+                sub = st["submit"]
+                prompt = np.asarray(sub["prompt"], np.int32)
+                req = _Request(rid, prompt,
+                               int(sub["max_new_tokens"]),
+                               float(sub["temperature"]),
+                               sub.get("eos_id"), self.deadline_s,
+                               self._digest_chain(prompt),
+                               trace_id=sub.get("trace"),
+                               rng_seed=sub.get("rng_seed"))
+                if sub.get("version"):
+                    req.version = sub["version"]
+                acked = None
+                if delivered is not None:
+                    acked = delivered.get(rid, delivered.get(str(rid)))
+                if acked is not None:
+                    req.delivered = [int(t) for t in acked]
+                else:
+                    req.delivered = [-1] * int(st.get("watermark", 0))
+                # the id counter must clear every adopted id, or a new
+                # submit would collide with a live adopted request
+                self._ids = max(self._ids, rid)
+                self._live[rid] = req
+                self._outstanding += 1
+                handles[rid] = req.handle
+                rep = None
+                last = st["dispatches"][-1] if st["dispatches"] else None
+                if last is not None:
+                    k = int(last["replica"])
+                    if 0 <= k < len(self._replicas):
+                        cand = self._replicas[k]
+                        if cand.healthy and cand.wfile is not None:
+                            rep = cand
+                if rep is not None:
+                    # reattach under the PREDECESSOR'S wire id — the
+                    # replica's retained tail is keyed by it
+                    req.attempt = int(last["attempt"])
+                    wire_id = f"{rid}.{req.attempt}"
+                    try:
+                        send_msg(rep.wfile, rep.wlock,
+                                 {"op": "reattach", "id": wire_id,
+                                  "epoch": self.epoch})
+                    except (OSError, ValueError):
+                        rep = None
+                    else:
+                        req.active[wire_id] = rep.id
+                        rep.inflight[wire_id] = req
+                        req.last_dispatch = time.monotonic()
+                        readopted += 1
+                if rep is None:
+                    redispatched += 1
+                    self._m_redispatched.inc()
+                    self._queue.append(req)
+            self._m_queue_depth.set(len(self._queue))
+            self._mu.notify_all()
+        return {"readopted": readopted, "redispatched": redispatched,
+                "handles": handles}
 
     # -- placement -----------------------------------------------------
     def _digest_chain(self, prompt: np.ndarray) -> List[str]:
@@ -821,12 +1006,17 @@ class Router:
                "max_new_tokens": req.max_new_tokens,
                "temperature": req.temperature, "eos_id": req.eos_id,
                "rng_seed": req.rng_seed,
-               "trace": req.trace, "pspan": req.span}
+               "trace": req.trace, "pspan": req.span,
+               "epoch": self.epoch}
         try:
             send_msg(rep.wfile, rep.wlock, msg)
         except (OSError, ValueError, AttributeError):
             self._replica_down_locked(rep, "send_failed")
             return
+        if self._journal is not None:
+            # a successor reads the LAST dispatch to know which replica
+            # may hold this request's retained token tail
+            self._journal.dispatch(str(req.id), req.attempt, rep.id)
         # model-version affinity latches at the FIRST successful
         # dispatch: from here on this request only ever runs on
         # replicas serving the same model version (rollout invariant:
@@ -847,6 +1037,17 @@ class Router:
             self._prefix_owner[digest] = rep.id
         while len(self._prefix_owner) > self._prefix_owner_cap:
             self._prefix_owner.pop(next(iter(self._prefix_owner)))
+        # hot-chain heat for the heal-time prefetch: remember the
+        # paged prompts traffic keeps landing on (and what to replay
+        # into migrate_in to pull them)
+        if req.digests:
+            deepest = req.digests[-1]
+            heat = self._chain_heat.pop(deepest, (0, None, None))[0]
+            self._chain_heat[deepest] = (
+                heat + 1, list(req.digests),
+                [int(t) for t in req.prompt])
+            while len(self._chain_heat) > self._chain_heat_cap:
+                self._chain_heat.pop(next(iter(self._chain_heat)))
         # canary mirroring: a slice of greedy attempt-1 traffic ALSO
         # runs on the new-checkpoint canary, compare-only
         if (self._mirror is not None and req.attempt == 1
@@ -857,6 +1058,11 @@ class Router:
         target = chaos.replica_kill(seq, rep.id)
         if target is not None:
             self._kill_replica(target)
+        # chaos router_kill@req:N — the ROUTER dies at the Nth
+        # dispatch, mid-burst, journal un-synced past the fsync
+        # cadence: the takeover case router_ha_smoke pins
+        if chaos.router_kill(seq):
+            self._crash()
 
     def _maybe_hedge_locked(self, now: float) -> None:
         for req in self._live.values():
@@ -931,7 +1137,8 @@ class Router:
                       "max_new_tokens": req.max_new_tokens,
                       "temperature": req.temperature,
                       "eos_id": req.eos_id, "rng_seed": req.rng_seed,
-                      "trace": req.trace, "pspan": req.span})
+                      "trace": req.trace, "pspan": req.span,
+                      "epoch": self.epoch})
         except (OSError, ValueError):
             return
         self._shadows[wire_id] = sh
@@ -987,7 +1194,8 @@ class Router:
             if rep.wfile is not None:
                 try:
                     send_msg(rep.wfile, rep.wlock,
-                             {"op": "cancel", "id": sh.wire_id})
+                             {"op": "cancel", "id": sh.wire_id,
+                              "epoch": self.epoch})
                     self._m_cancel.inc()
                 except (OSError, ValueError):
                     pass
@@ -1016,9 +1224,48 @@ class Router:
                       "router neither owns its process nor has a "
                       "kill_hook", target)
 
+    def _crash(self) -> None:
+        """Die NOW, uncleanly — chaos router_kill.  No drain, no
+        journal sync, no request resolution: the successor must
+        recover from exactly what a SIGKILL leaves behind.  In-process
+        tiers (tests) substitute ``crash_hook``, which must freeze
+        this router the same way (close transports, stop loops,
+        resolve nothing)."""
+        if self._crash_hook is not None:
+            self._crash_hook()
+            return
+        log.error("router: chaos router_kill — dying uncleanly")
+        os._exit(chaos.EXIT_INJECTED_CRASH)
+
     # -- replica message handling --------------------------------------
     def _on_msg(self, rep: _Replica, msg: dict) -> None:
         op = msg.get("op")
+        if op == "stale_epoch":
+            # a replica refused one of our ops: a successor holds a
+            # higher fencing epoch.  LATCH fenced — this router must
+            # stop driving the tier entirely (shed new submits, stop
+            # resolving), because anything it delivered from here on
+            # could double what the real leader delivers.
+            with self._mu:
+                self._m_stale_epoch.inc()
+                if not self._fenced:
+                    self._fenced = True
+                    log.error(
+                        "router: FENCED — replica %d rejected epoch %d "
+                        "(current %s); a successor has taken over",
+                        rep.id, self.epoch, msg.get("current"))
+                    trace.anomaly("router_fenced", epoch=self.epoch,
+                                  current=msg.get("current"),
+                                  replica=rep.id)
+                req = rep.inflight.pop(msg.get("id"), None)
+                if req is not None and not req.done:
+                    req.active.pop(msg.get("id"), None)
+                    self._resolve_locked(req, exc=RuntimeError(
+                        f"request {req.id} fenced: router epoch "
+                        f"{self.epoch} superseded by "
+                        f"{msg.get('current')} — the new leader "
+                        f"re-adopted it"))
+            return
         if op == "stats":
             tag = msg.get("tag", "")
             with self._mu:
@@ -1050,14 +1297,41 @@ class Router:
             if req is None or req.done:
                 self._m_stale.inc()
                 return
+            if op == "reattached":
+                # the replica still held this request's retained tail:
+                # re-adoption confirmed.  The tail itself arrives as
+                # ordinary token/done msgs (replayed from i=0) and runs
+                # the SAME verify+dedupe as a failover replay — nothing
+                # else to do here but say so.
+                self._m_readopted.inc()
+                trace.event("router_readopt", request=req.id,
+                            trace=req.trace, replica=rep.id,
+                            retained=int(msg.get("n", 0)),
+                            engine_done=bool(msg.get("done")))
+                return
+            if op == "reattach_nack":
+                # the request died WITH the replica during the outage
+                # (respawn, or the tail was pruned): fall through to
+                # ordinary budgeted failover — the journaled rng_seed
+                # makes the re-dispatch token-exact anyway
+                rep.inflight.pop(wire_id, None)
+                req.active.pop(wire_id, None)
+                self._m_redispatched.inc()
+                self._requeue_locked(req, reason="reattach_nack")
+                return
             if op == "token":
                 i = int(msg["i"])
                 tok = int(msg["token"])
                 if i < len(req.delivered):
                     # re-dispatched attempt replaying delivered ground:
                     # verify, don't re-emit (greedy decode makes this an
-                    # equality by construction)
-                    if req.delivered[i] != tok and not req.diverged:
+                    # equality by construction).  A -1 is a takeover
+                    # WATERMARK SENTINEL — the journal said the dead
+                    # router delivered this index but not its value:
+                    # fill it in, still don't re-emit.
+                    if req.delivered[i] == -1:
+                        req.delivered[i] = tok
+                    elif req.delivered[i] != tok and not req.diverged:
                         req.diverged = True
                         self._m_diverged.inc()
                         trace.anomaly("redispatch_divergence",
@@ -1071,9 +1345,22 @@ class Router:
                         # the stream-delivery milestone of the timeline
                         trace.event("router_first_token", request=req.id,
                                     trace=req.trace, replica=rep.id)
+                        if self._journal is not None:
+                            self._journal.first_token(str(req.id))
                     req.delivered.append(tok)
                     req.last_progress = time.monotonic()
                     req.handle._emit(tok)
+                    if (self._journal is not None
+                            and len(req.delivered) % 16 == 0):
+                        # bounded-cadence watermark: a successor seeds
+                        # its dedupe index at >= this, so re-adoption
+                        # VERIFIES the delivered prefix instead of
+                        # re-emitting it.  Every 16 tokens, not every
+                        # token — the journal stays O(1)-ish per
+                        # request, and the watermark only has to be a
+                        # LOWER bound (the sentinel fill covers the gap)
+                        self._journal.watermark(str(req.id),
+                                                len(req.delivered))
                 else:
                     self._m_stale.inc()
             elif op == "done":
@@ -1087,7 +1374,11 @@ class Router:
                 tokens = [int(t) for t in msg["tokens"]]
                 for i in range(len(req.delivered), len(tokens)):
                     req.handle._emit(tokens[i])
-                if (req.delivered != tokens[:len(req.delivered)]
+                # -1s are takeover watermark sentinels (value unknown,
+                # delivery known) — they verify against anything
+                if ((len(req.delivered) > len(tokens)
+                     or any(d != -1 and d != t
+                            for d, t in zip(req.delivered, tokens)))
                         and not req.diverged):
                     req.diverged = True
                     self._m_diverged.inc()
@@ -1198,7 +1489,8 @@ class Router:
             send_msg(target.wfile, target.wlock,
                      {"op": "migrate_in", "xfer": xfer,
                       "host": source.host, "port": source.port,
-                      "prompt": [int(t) for t in req.prompt]})
+                      "prompt": [int(t) for t in req.prompt],
+                      "epoch": self.epoch})
         except (OSError, ValueError):
             return
         self._migrations[xfer] = {
@@ -1224,6 +1516,10 @@ class Router:
                 self._prefix_owner.pop(d, None)
                 self._prefix_owner[d] = rep.id
             self._m_migrations.inc()
+            if mig.get("prefetch"):
+                # heal-time prefetch: these pages were pulled to warm
+                # a healed/respawned replica instead of re-prefilling
+                self._m_prefetch.inc(pages)
             trace.event("chain_migrated", xfer=xfer, trace=mig["trace"],
                         source=mig["source"], target=rep.id,
                         pages=pages)
@@ -1253,6 +1549,10 @@ class Router:
         if req.done:
             return
         req.done = True
+        if self._journal is not None:
+            # terminal either way — a failed request needs nothing
+            # from a successor any more than a completed one does
+            self._journal.complete(str(req.id), ok=exc is None)
         self._live.pop(req.id, None)
         if req in self._queue:
             self._queue.remove(req)
@@ -1269,7 +1569,8 @@ class Router:
             if rep.wfile is not None:
                 try:
                     send_msg(rep.wfile, rep.wlock,
-                             {"op": "cancel", "id": wid})
+                             {"op": "cancel", "id": wid,
+                              "epoch": self.epoch})
                     self._m_cancel.inc()
                 except (OSError, ValueError):
                     pass
@@ -1521,7 +1822,49 @@ class Router:
                         port=rep.port, pid=rep.announced_pid)
             log.info("router: replica %d registered (port %s, pid %s)",
                      rep.id, rep.port, rep.announced_pid)
+            self._maybe_prefetch_locked(rep)
             self._mu.notify_all()
+
+    def _maybe_prefetch_locked(self, rep: _Replica) -> None:
+        """Warm a just-healed/respawned replica: pull the HOTTEST
+        tracked prompt chain from its current owner over the existing
+        ``migrate_in`` wire pull, so the first affinity-miss burst the
+        prober routes here decodes against warm pages instead of
+        re-prefilling the system prompt.  Pure efficiency — every
+        skip condition just means the next miss re-prefills, exactly
+        the pre-prefetch behavior."""
+        if self.prefill_replicas and rep.role != "decode":
+            return   # the prefill pool re-prefills by design
+        for deepest, (heat, digests, prompt) in sorted(
+                self._chain_heat.items(), key=lambda kv: -kv[1][0]):
+            owner = self._prefix_owner.get(deepest)
+            if owner is None or owner == rep.id:
+                continue
+            src = self._replicas[owner]
+            if (not src.healthy or src.port is None
+                    or src.version != rep.version):
+                continue
+            if any(m["digests"] and m["digests"][-1] == deepest
+                   for m in self._migrations.values()):
+                continue
+            xfer = f"p{rep.id}.{rep.generation}.{deepest[:8]}"
+            try:
+                send_msg(rep.wfile, rep.wlock,
+                         {"op": "migrate_in", "xfer": xfer,
+                          "host": src.host, "port": src.port,
+                          "prompt": list(prompt),
+                          "epoch": self.epoch})
+            except (OSError, ValueError):
+                return
+            self._migrations[xfer] = {
+                "digests": list(digests), "source": src.id,
+                "target": rep.id, "t0": time.monotonic(),
+                "trace": trace.new_trace_id(), "prefetch": True}
+            trace.event("chain_migrate", trace=self._migrations[
+                            xfer]["trace"], xfer=xfer, source=src.id,
+                        target=rep.id, pages=len(digests),
+                        prefetch=True, heat=heat)
+            return   # one chain per heal: warmth, not a transfer storm
 
     # -- rollout control surface (serve/rollout.py drives these) --------
     def set_replica_version(self, replica_id: int, version: str) -> None:
@@ -1589,7 +1932,8 @@ class Router:
         with self._mu:
             if rep.wfile is not None:
                 try:
-                    send_msg(rep.wfile, rep.wlock, {"op": "drain"})
+                    send_msg(rep.wfile, rep.wlock,
+                             {"op": "drain", "epoch": self.epoch})
                 except (OSError, ValueError):
                     pass
             trace.event("replica_drain", replica=replica_id,
@@ -1697,6 +2041,21 @@ class Router:
         from dtf_tpu.serve.rollout import RolloutController
         return RolloutController(self, new_checkpoint, **kw).run()
 
+    def fence(self) -> None:
+        """Mark this router superseded (serve/ha.py LeaseKeeper's
+        ``on_fenced``: the lease shows a higher epoch).  Latched — a
+        fenced router sheds every new submit and never drives the tier
+        again; the replicas' stale-epoch rejections enforce the same
+        verdict at the wire for anything already in flight."""
+        with self._mu:
+            if self._fenced:
+                return
+            self._fenced = True
+            log.error("router: FENCED (epoch %d) — lease lost to a "
+                      "successor", self.epoch)
+            trace.anomaly("router_fenced", epoch=self.epoch,
+                          source="lease")
+
     # -- introspection -------------------------------------------------
     def health(self) -> dict:
         """The /healthz payload (obs/prom.py MetricsServer health_fn):
@@ -1706,10 +2065,16 @@ class Router:
             healthy = [r.healthy for r in self._replicas]
             return {
                 "ok": any(healthy) and not self._stopping
-                      and not self._draining,
+                      and not self._draining and not self._fenced,
                 "draining": self._draining,
                 "replicas_healthy": healthy,
                 "outstanding": self._outstanding,
+                # HA posture for external probes: which role this
+                # process plays, under which fencing epoch, and
+                # whether a successor has fenced it off
+                "role": self.role,
+                "epoch": self.epoch,
+                "fenced": self._fenced,
             }
 
     def replica_healthy(self, replica_id: int) -> bool:
@@ -1735,7 +2100,8 @@ class Router:
             self._stats_events[(rep.id, tag)] = ev
             try:
                 send_msg(rep.wfile, rep.wlock,
-                         {"op": "stats", "tag": tag})
+                         {"op": "stats", "tag": tag,
+                          "epoch": self.epoch})
             except (OSError, ValueError):
                 self._stats_events.pop((rep.id, tag), None)
                 return None
@@ -1762,7 +2128,8 @@ class Router:
                 return False
             try:
                 send_msg(rep.wfile, rep.wlock,
-                         {"op": "reset_measurement"})
+                         {"op": "reset_measurement",
+                          "epoch": self.epoch})
             except (OSError, ValueError):
                 return False
         return True

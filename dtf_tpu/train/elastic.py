@@ -59,6 +59,47 @@ EXIT_DEVICE_LOST = 76
 DEVICES_ENV = "DTF_ELASTIC_DEVICES"
 REJOIN_FILE = "elastic_rejoin.json"
 
+# XLA runtime error-text markers that mean THE ACCELERATORS ARE GONE
+# (slice preemption, PCIe/ICI fault, TPU driver reset) rather than a
+# bug in the step: jaxlib surfaces them as XlaRuntimeError with a
+# status-code prefix.  Matched case-insensitively against both the
+# exception type name and its message — jaxlib moves the exception
+# class between releases (jax.errors / jaxlib.xla_extension), so the
+# classifier keys on the STABLE parts: the runtime's status vocabulary.
+_DEVICE_LOSS_MARKERS = (
+    "device_lost", "device lost", "data_loss",
+    "failed_precondition: device", "device or resource busy",
+    "tpu driver", "device is in an invalid state",
+)
+
+
+class DeviceLost(RuntimeError):
+    """An XLA runtime failure classified as accelerator loss: the host
+    survives but its chips are gone.  The train loop converts the
+    runtime's exception into this, and the runner exits
+    ``EXIT_DEVICE_LOST`` so an ``--elastic`` supervisor RESHARDS onto
+    the surviving topology instead of burning the crash budget on a
+    fault no same-size restart can fix."""
+
+    def __init__(self, step: int, cause: BaseException):
+        super().__init__(
+            f"device loss at step {step}: "
+            f"{type(cause).__name__}: {cause}")
+        self.step = int(step)
+        self.cause = cause
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """True when ``exc`` is the XLA runtime reporting accelerator loss
+    (vs an ordinary step-function error, which must keep crashing the
+    normal way — misclassifying a NaN-shaped bug as device loss would
+    make the supervisor shrink a healthy topology forever)."""
+    name = type(exc).__name__.lower()
+    if "xlaruntimeerror" not in name and "runtimeerror" not in name:
+        return False
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in text for m in _DEVICE_LOSS_MARKERS)
+
 
 def announce_rejoin(log_dir: str, devices: int) -> str:
     """Re-announce capacity to a shrunken job's supervisor: a healed

@@ -1265,17 +1265,30 @@ class Trainer:
                     # detection; SYNCED wall-clock timing comes from the
                     # "log_window" spans below (and the "compile" span,
                     # whose first call blocks on trace+compile).
-                    if compile_pending:
-                        compile_pending = False
-                        with trace.span("compile", step=global_step):
-                            if ledger_on:
-                                step_fn = self._compile_with_ledger(
-                                    ledger, state, sharded)
+                    try:
+                        if compile_pending:
+                            compile_pending = False
+                            with trace.span("compile", step=global_step):
+                                if ledger_on:
+                                    step_fn = self._compile_with_ledger(
+                                        ledger, state, sharded)
+                                with trace.span("step", step=global_step):
+                                    state, metrics = step_fn(state,
+                                                             *sharded)
+                        else:
                             with trace.span("step", step=global_step):
                                 state, metrics = step_fn(state, *sharded)
-                    else:
-                        with trace.span("step", step=global_step):
-                            state, metrics = step_fn(state, *sharded)
+                    except Exception as e:  # noqa: BLE001 — classify,
+                        # never swallow: only a recognized accelerator
+                        # loss is translated; everything else keeps its
+                        # ordinary crash path (traceback + crash budget)
+                        from dtf_tpu.train import elastic as elastic_lib
+                        if elastic_lib.is_device_loss(e):
+                            trace.anomaly("device_lost", step=global_step,
+                                          error=f"{type(e).__name__}: {e}")
+                            raise elastic_lib.DeviceLost(global_step,
+                                                         e) from e
+                        raise
                     global_step += 1
                     if global_step % cfg.log_steps == 0:
                         # device_get (host copy): block_until_ready can

@@ -58,6 +58,33 @@ def test_contract_parity():
     assert elastic.DEVICES_ENV == launch.ELASTIC_DEVICES_ENV
 
 
+def test_device_loss_classifier():
+    """The XLA runtime's device-loss exception — recognized by its
+    type NAME and status-text markers (jaxlib moves the class between
+    releases, so the classifier must not import it) — classifies as
+    device loss; ordinary step bugs do not."""
+    # the real exception type is jaxlib's XlaRuntimeError; fake one by
+    # name, exactly as a version-skewed jaxlib would present it
+    XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+    lost = XlaRuntimeError(
+        "INTERNAL: DEVICE_LOST: TPU driver reset detected")
+    assert elastic.is_device_loss(lost)
+    assert elastic.is_device_loss(
+        XlaRuntimeError("DATA_LOSS: core halted unexpectedly"))
+    # same type, ordinary failure text: NOT device loss — shrinking a
+    # healthy topology on a shape bug would be a policy disaster
+    assert not elastic.is_device_loss(
+        XlaRuntimeError("INVALID_ARGUMENT: shapes do not match"))
+    # right text, wrong exception family (a ValueError from user code
+    # quoting logs): NOT device loss
+    assert not elastic.is_device_loss(ValueError("DEVICE_LOST"))
+    wrapped = elastic.DeviceLost(17, lost)
+    assert wrapped.step == 17 and wrapped.cause is lost
+    assert "DEVICE_LOST" in str(wrapped)
+    # the runner's handler recognizes the wrapper as already-classified
+    assert isinstance(wrapped, RuntimeError)
+
+
 def test_chaos_grammar_device_and_host_loss():
     specs = chaos.parse_spec("device_loss@step:3,host_loss@rank1:step:5")
     assert [str(s) for s in specs] == ["device_loss@step:3",

@@ -417,3 +417,88 @@ def test_router_disagg_migrates_and_rehomes(model_and_params):
             s.stop()
         for e in engines:
             e.stop()
+
+
+@pytest.mark.slow
+def test_prefetch_on_heal_warms_replica_token_exact(model_and_params):
+    """A healed replica gets the hottest tracked prompt chain
+    prefetched from its owner (router HA satellite: the failover/heal
+    handoff) — counted by router_prefetch_pages_total, and decode on
+    the prefetched pages is token-exact against the original answer."""
+    import tempfile
+    from dtf_tpu.obs.watchdog import Heartbeat, heartbeat_path
+    from dtf_tpu.serve.router import Router
+
+    rdv = tempfile.mkdtemp()
+    engines, servers, stops = [], [], []
+    hbs = []
+    for rid in range(2):
+        eng = make_engine(model_and_params)
+        srv = ReplicaServer(eng, rid, rdv).start()
+        stop = threading.Event()
+        pause = threading.Event()
+        hb = Heartbeat(heartbeat_path(rdv, rid), interval_s=0.04)
+
+        def beat(stop=stop, pause=pause, hb=hb):
+            while not stop.wait(0.04):
+                if not pause.is_set():
+                    hb.beat(step=0)
+
+        threading.Thread(target=beat, daemon=True).start()
+        engines.append(eng)
+        servers.append(srv)
+        stops.append(stop)
+        hbs.append(pause)
+    router = Router(2, rdv, probe_interval_s=0.05,
+                    health_timeout_s=0.5, deadline_s=30.0,
+                    replica_inflight=32, page_size=PS,
+                    migrate_timeout_s=10.0)
+    router.start(wait_s=10)
+    try:
+        # heat a paged chain on its affinity home
+        prompt = _prompt(3 * PS + 7, salt=29)
+        r1 = router.generate(prompt, max_new_tokens=8)
+        for _ in range(2):
+            assert router.generate(
+                prompt, max_new_tokens=8).tokens == r1.tokens
+        home = r1.replica
+        other = 1 - home
+        # the OTHER replica blips (heartbeat pause past the timeout)…
+        hbs[other].set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and router.replica_healthy(other):
+            time.sleep(0.02)
+        assert not router.replica_healthy(other)
+        # …and heals: the heal handoff prefetches the hot chain
+        hbs[other].clear()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (router.replica_healthy(other)
+                    and router.migration_stats()["migrated"] >= 1):
+                break
+            time.sleep(0.05)
+        assert router.migration_stats()["migrated"] >= 1
+        pages = router.metrics.get("router_prefetch_pages_total").value
+        assert pages >= 3
+        # force traffic onto the healed replica: the chain's owner goes
+        # down, affinity re-homes, and decode runs on PREFETCHED pages
+        hbs[home].set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline \
+                and router.replica_healthy(home):
+            time.sleep(0.02)
+        r2 = router.generate(prompt, max_new_tokens=8)
+        assert r2.replica == other
+        assert r2.tokens == r1.tokens            # token-exact on warm pages
+        hits = engines[other].metrics.get(
+            "serve_prefix_hit_pages_total").value
+        assert hits >= 3
+    finally:
+        router.stop(drain=False)
+        for s in stops:
+            s.set()
+        for s in servers:
+            s.stop()
+        for e in engines:
+            e.stop()

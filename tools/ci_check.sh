@@ -131,9 +131,20 @@
 #      fail over); and a page_fetch_stall chaos arm proves a
 #      congested fabric is an efficiency loss, never a correctness
 #      event.
+#  17. tools/router_ha_smoke.py — the router high-availability
+#      contract (serve/ha.py + the request journal, over real replica
+#      subprocesses): the leader router is SIGKILLed mid-burst
+#      (router_kill chaos — dispatches in flight, journal tail
+#      un-synced), a warm standby waits out the fenced lease, adopts
+#      the LIVE tier (zero replica respawns, engine pids stable),
+#      replays the journal, and every client stream is exactly-once
+#      TOKEN-EXACT vs an unfaulted baseline with zero lost requests;
+#      a split-brain usurper fences the deposed leader at the
+#      replicas (stale_epoch); and lease_stall chaos proves a
+#      GC-paused leader discovers it is fenced instead of resuming.
 #
 # Usage: tools/ci_check.sh            # the full contract
-#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-16 only
+#        CI_CHECK_SKIP_TESTS=1 tools/ci_check.sh   # stages 2-17 only
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -141,18 +152,18 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
 
 if [ "${CI_CHECK_SKIP_TESTS:-0}" != "1" ]; then
-    echo "== ci_check [1/16]: tier-1 test suite =="
+    echo "== ci_check [1/17]: tier-1 test suite =="
     timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:xdist -p no:randomly
 else
-    echo "== ci_check [1/16]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
+    echo "== ci_check [1/17]: SKIPPED (CI_CHECK_SKIP_TESTS=1) =="
 fi
 
-echo "== ci_check [2/16]: marker audit (test-budget contract) =="
+echo "== ci_check [2/17]: marker audit (test-budget contract) =="
 python tools/marker_audit.py
 
-echo "== ci_check [3/16]: traced smoke run =="
+echo "== ci_check [3/17]: traced smoke run =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$TRACE_DIR"' EXIT
 python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
@@ -160,13 +171,13 @@ python -m dtf_tpu.cli.lm_main --use_synthetic_data --train_steps 3 \
     --model_dir "$TRACE_DIR/run" --skip_checkpoint \
     --trace_dir "$TRACE_DIR" >/dev/null
 
-echo "== ci_check [4/16]: anomaly cleanliness =="
+echo "== ci_check [4/17]: anomaly cleanliness =="
 python -m dtf_tpu.cli.trace_main "$TRACE_DIR" --check
 
-echo "== ci_check [5/16]: chaos smoke (kill -> resume -> exactness) =="
+echo "== ci_check [5/17]: chaos smoke (kill -> resume -> exactness) =="
 python tools/chaos_smoke.py
 
-echo "== ci_check [6/16]: parallelism planner (check + calibration) =="
+echo "== ci_check [6/17]: parallelism planner (check + calibration) =="
 python bench_plan.py --out "$TRACE_DIR/PLAN_4x4.json" >/dev/null
 python -m dtf_tpu.cli.plan_main --devices 8 --model transformer_small \
     --dataset lm --use_synthetic_data --seq_len 64 --batch_size 8 \
@@ -180,36 +191,39 @@ python -m dtf_tpu.cli.plan_main --model transformer_small --dataset lm \
     --benchmark_log_dir "$TRACE_DIR/plan_bench"
 grep -q plan_step_time_ratio "$TRACE_DIR/plan_bench/metric.log"
 
-echo "== ci_check [7/16]: data-service smoke (sharded determinism + imagenet resume exactness) =="
+echo "== ci_check [7/17]: data-service smoke (sharded determinism + imagenet resume exactness) =="
 python tools/data_service_smoke.py
 
-echo "== ci_check [8/16]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
+echo "== ci_check [8/17]: multi-device serve smoke (TP exactness + prefix-sharing/streaming bars) =="
 python tools/serve_smoke.py
 
-echo "== ci_check [9/16]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
+echo "== ci_check [9/17]: router smoke (replica tier: kill/partition/slow chaos -> token-exact failover) =="
 python tools/router_smoke.py
 
-echo "== ci_check [10/16]: perf-regression gate (committed history passes, injected regression fails) =="
+echo "== ci_check [10/17]: perf-regression gate (committed history passes, injected regression fails) =="
 python tools/bench_gate.py --smoke
 
-echo "== ci_check [11/16]: capacity-simulator smoke (record -> replay -> calibrate) =="
+echo "== ci_check [11/17]: capacity-simulator smoke (record -> replay -> calibrate) =="
 python -m dtf_tpu.cli.plan_serve_main --calibrate --calibrate_tolerance 2.0 \
     --benchmark_log_dir "$TRACE_DIR/serve_plan_bench"
 grep -q plan_serve_tokens_ratio "$TRACE_DIR/serve_plan_bench/metric.log"
 
-echo "== ci_check [12/16]: rollout smoke (zero-downtime rollout: canary gate, rollback, rollout chaos) =="
+echo "== ci_check [12/17]: rollout smoke (zero-downtime rollout: canary gate, rollback, rollout chaos) =="
 python tools/rollout_smoke.py
 
-echo "== ci_check [13/16]: dtflint (static analysis: lock discipline, determinism, vocab closure, flag wiring) =="
+echo "== ci_check [13/17]: dtflint (static analysis: lock discipline, determinism, vocab closure, flag wiring) =="
 python -m tools.dtflint
 
-echo "== ci_check [14/16]: zero smoke (ZeRO-2/3 ≡ replicated, infeasible-replicated config trains, measured overlap, 2x calibration) =="
+echo "== ci_check [14/17]: zero smoke (ZeRO-2/3 ≡ replicated, infeasible-replicated config trains, measured overlap, 2x calibration) =="
 python tools/zero_smoke.py
 
-echo "== ci_check [15/16]: elastic smoke (host/device loss -> shrink resume oracle-exact -> grow back) =="
+echo "== ci_check [15/17]: elastic smoke (host/device loss -> shrink resume oracle-exact -> grow back) =="
 python tools/elastic_smoke.py
 
-echo "== ci_check [16/16]: disagg smoke (prefill/decode split: migrate -> re-home token-exact, kill prefill replica -> zero lost, stalled fabric) =="
+echo "== ci_check [16/17]: disagg smoke (prefill/decode split: migrate -> re-home token-exact, kill prefill replica -> zero lost, stalled fabric) =="
 python tools/disagg_smoke.py
+
+echo "== ci_check [17/17]: router HA smoke (leader kill -> journal takeover exactly-once, split brain fenced, lease stall) =="
+python tools/router_ha_smoke.py
 
 echo "ci_check: OK"

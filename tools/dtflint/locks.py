@@ -103,9 +103,11 @@ class _MethodChecker(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node) -> None:
         # a closure defined here runs LATER, possibly without the
-        # lock: check its body as if nothing were held
+        # lock: check its body as if nothing were held (a Lambda's
+        # body is a single expression, not a statement list)
         saved, self.held = self.held, []
-        for stmt in node.body:
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
             self.visit(stmt)
         self.held = saved
 

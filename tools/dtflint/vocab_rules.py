@@ -48,6 +48,8 @@ CHAOS_PROBES = {
     "device_loss": "step",
     "host_loss": "step",
     "page_fetch_stall": "page_fetch_stall",
+    "router_kill": "router_kill",
+    "lease_stall": "lease_stall",
 }
 
 _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)+$")
